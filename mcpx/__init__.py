@@ -1,0 +1,34 @@
+"""mcpx — a TPU-native autonomous microservice-composition framework.
+
+A brand-new implementation of the capabilities of the reference MCP control
+plane (``anubhaparashar/Autonomous-Microservice-Composition-via-LLM-Agents-in-
+an-MCP-Control-Plane``, see ``/root/reference/control_plane.py``): user intents
+are planned into executable service DAGs by an *in-tree* JAX/XLA LLM inference
+engine (Gemma-architecture, Pallas ragged paged-attention decode, grammar-
+constrained JSON emission), services are retrieved by an HBM-resident embedding
+table with on-device top-k, and DAGs are executed by a concurrent orchestrator
+with retry budgets, ordered fallbacks and telemetry-adaptive replanning.
+
+The API surface matches the reference (``/plan``, ``/execute``,
+``/plan_and_execute`` — reference ``control_plane.py:133-151``) but the whole
+stack is designed TPU-first: SPMD over a named ``jax.sharding.Mesh``,
+functional transforms, static-shape decode loops, Pallas kernels for the hot
+ops.
+
+Layout (SURVEY.md §7):
+  core/        DAG IR, typed config, errors, execution traces
+  registry/    service registry backends (in-memory, file, redis-gated)
+  telemetry/   metrics, rolling per-service stats, replan policy
+  orchestrator/ concurrent DAG executor (retries, ordered fallbacks, traces)
+  planner/     planner interface: mock, heuristic, LLM (grammar-constrained)
+  models/      Gemma-architecture decoder in flax.linen
+  engine/      mesh/sharding, paged KV cache, continuous-batching scheduler,
+               Pallas kernels (engine/kernels/)
+  retrieval/   schema embedder + HBM top-k index
+  server/      aiohttp application exposing the control-plane API
+  parallel/    mesh + collective helpers (TP/DP axes over ICI)
+  ops/         re-exports of the kernel ops
+  utils/       small shared utilities
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,30 @@
+from mcpx.core.dag import DagEdge, DagNode, Plan, PlanValidationError
+from mcpx.core.config import MCPXConfig
+from mcpx.core.errors import (
+    ConfigError,
+    EngineError,
+    ExecutionError,
+    MCPXError,
+    PlannerError,
+    RegistryError,
+)
+from mcpx.core.trace import ExecutionTrace, NodeAttempt, NodeTrace, Span, new_trace_id
+
+__all__ = [
+    "DagEdge",
+    "DagNode",
+    "Plan",
+    "PlanValidationError",
+    "MCPXConfig",
+    "MCPXError",
+    "ConfigError",
+    "PlannerError",
+    "RegistryError",
+    "ExecutionError",
+    "EngineError",
+    "ExecutionTrace",
+    "NodeAttempt",
+    "NodeTrace",
+    "Span",
+    "new_trace_id",
+]

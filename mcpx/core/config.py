@@ -1,0 +1,210 @@
+"""Typed configuration, loadable from defaults, a JSON file, or env vars.
+
+The reference configures itself with three ``os.getenv`` calls *at import
+time* (reference ``control_plane.py:17-19``) and eagerly connects to Postgres
+in a constructor (``control_plane.py:48``, bug B8). Here configuration is a
+plain dataclass tree with no import-time side effects, validated explicitly by
+``MCPXConfig.validate()`` at startup; backends are constructed from it by the
+application factory, never at import.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from mcpx.core.errors import ConfigError
+
+
+@dataclass
+class ServerConfig:
+    host: str = "0.0.0.0"
+    port: int = 8000
+    # Max concurrent in-flight /plan_and_execute requests before 429.
+    max_concurrency: int = 1024
+    request_timeout_s: float = 120.0
+
+
+@dataclass
+class RegistryConfig:
+    # "memory" | "file" | "redis"
+    backend: str = "memory"
+    file_path: str = ""
+    redis_url: str = ""
+    # Key prefix kept for reference compatibility (control_plane.py:20).
+    prefix: str = "mcp:service:"
+
+
+@dataclass
+class ModelConfig:
+    # Named Gemma-architecture size: "test" | "2b" | "7b" (models/gemma/config.py)
+    size: str = "test"
+    checkpoint_path: str = ""
+    dtype: str = "bfloat16"
+    vocab: str = "byte"  # in-tree byte-level tokenizer (no external files)
+    max_seq_len: int = 2048
+
+
+@dataclass
+class EngineConfig:
+    # Mesh axis sizes; data*model must divide len(jax.devices()) usage site.
+    data_axis: int = 1
+    model_axis: int = 1
+    kv_page_size: int = 16  # tokens per KV page
+    max_pages_per_seq: int = 128
+    max_batch_size: int = 32
+    max_prefill_tokens: int = 4096
+    decode_steps_per_tick: int = 8
+    max_decode_len: int = 512
+    # Sampling defaults: temperature matches the reference planner call,
+    # control_plane.py:72.
+    temperature: float = 0.2
+    top_k: int = 0  # 0 = full softmax sampling / greedy if temperature==0
+    use_pallas: bool = True
+    interpret: bool = False  # run Pallas kernels in interpret mode (CPU CI)
+
+
+@dataclass
+class RetrievalConfig:
+    embed_dim: int = 256
+    top_k: int = 8
+    # Refresh the HBM table when the registry version changes.
+    auto_refresh: bool = True
+
+
+@dataclass
+class TelemetryConfig:
+    enabled: bool = True
+    # EWMA smoothing for per-service latency/error-rate.
+    ewma_alpha: float = 0.2
+    # Replan when a node's observed error-rate breaches this threshold.
+    replan_error_rate: float = 0.5
+    # or when latency exceeds this multiple of the registry's cost profile.
+    replan_latency_factor: float = 4.0
+    max_replans: int = 2
+
+
+@dataclass
+class OrchestratorConfig:
+    default_retries: int = 1
+    default_timeout_s: float = 5.0  # reference per-node timeout, control_plane.py:109
+    retry_backoff_s: float = 0.05
+    retry_backoff_multiplier: float = 2.0
+    max_node_concurrency: int = 256
+
+
+@dataclass
+class PlannerConfig:
+    # "llm" | "heuristic" | "mock"
+    kind: str = "heuristic"
+    max_plan_retries: int = 2
+    shortlist_top_k: int = 8
+    max_prompt_tokens: int = 1536
+    plan_cache_size: int = 4096
+    explain: bool = True
+
+
+@dataclass
+class MCPXConfig:
+    server: ServerConfig = field(default_factory=ServerConfig)
+    registry: RegistryConfig = field(default_factory=RegistryConfig)
+    model: ModelConfig = field(default_factory=ModelConfig)
+    engine: EngineConfig = field(default_factory=EngineConfig)
+    retrieval: RetrievalConfig = field(default_factory=RetrievalConfig)
+    telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
+    orchestrator: OrchestratorConfig = field(default_factory=OrchestratorConfig)
+    planner: PlannerConfig = field(default_factory=PlannerConfig)
+
+    # ------------------------------------------------------------------ load
+    @classmethod
+    def from_dict(cls, obj: dict[str, Any]) -> "MCPXConfig":
+        cfg = cls()
+        for section_name, section_obj in obj.items():
+            if not hasattr(cfg, section_name):
+                raise ConfigError(f"unknown config section '{section_name}'")
+            section = getattr(cfg, section_name)
+            if not isinstance(section_obj, dict):
+                raise ConfigError(f"config section '{section_name}' must be an object")
+            fields_by_name = {f.name: f for f in dataclasses.fields(section)}
+            for k, v in section_obj.items():
+                if k not in fields_by_name:
+                    raise ConfigError(f"unknown key '{section_name}.{k}'")
+                if isinstance(v, str):
+                    try:
+                        v = _coerce(v, fields_by_name[k].type)
+                    except (TypeError, ValueError) as e:
+                        raise ConfigError(f"bad value for {section_name}.{k}={v!r}: {e}") from e
+                setattr(section, k, v)
+        cfg.validate()
+        return cfg
+
+    @classmethod
+    def from_file(cls, path: str) -> "MCPXConfig":
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
+
+    @classmethod
+    def from_env(cls, env: Optional[dict[str, str]] = None) -> "MCPXConfig":
+        """Environment overrides use ``MCPX_<SECTION>_<KEY>`` naming; the
+        reference's ``REDIS_URL`` (control_plane.py:17) is honoured too."""
+        env = dict(os.environ if env is None else env)
+        cfg = cls()
+        if env.get("REDIS_URL"):
+            cfg.registry.redis_url = env["REDIS_URL"]
+        for section_field in dataclasses.fields(cfg):
+            section = getattr(cfg, section_field.name)
+            for f in dataclasses.fields(section):
+                key = f"MCPX_{section_field.name.upper()}_{f.name.upper()}"
+                if key in env:
+                    try:
+                        setattr(section, f.name, _coerce(env[key], f.type))
+                    except (TypeError, ValueError) as e:
+                        raise ConfigError(f"bad value for {key}={env[key]!r}: {e}") from e
+        cfg.validate()
+        return cfg
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    # -------------------------------------------------------------- validate
+    def validate(self) -> None:
+        problems: list[str] = []
+        if self.registry.backend not in ("memory", "file", "redis"):
+            problems.append(f"registry.backend '{self.registry.backend}' not in memory|file|redis")
+        if self.registry.backend == "file" and not self.registry.file_path:
+            problems.append("registry.backend=file requires registry.file_path")
+        if self.registry.backend == "redis" and not self.registry.redis_url:
+            problems.append("registry.backend=redis requires registry.redis_url")
+        if self.planner.kind not in ("llm", "heuristic", "mock"):
+            problems.append(f"planner.kind '{self.planner.kind}' not in llm|heuristic|mock")
+        if self.engine.kv_page_size <= 0 or self.engine.kv_page_size & (self.engine.kv_page_size - 1):
+            problems.append("engine.kv_page_size must be a positive power of two")
+        if self.engine.data_axis < 1 or self.engine.model_axis < 1:
+            problems.append("engine mesh axes must be >= 1")
+        if self.engine.max_batch_size < 1:
+            problems.append("engine.max_batch_size must be >= 1")
+        if not 0.0 < self.telemetry.ewma_alpha <= 1.0:
+            problems.append("telemetry.ewma_alpha must be in (0, 1]")
+        if self.retrieval.top_k < 1:
+            problems.append("retrieval.top_k must be >= 1")
+        if problems:
+            raise ConfigError("; ".join(problems))
+
+
+def _coerce(value: str, typ: Any) -> Any:
+    t = str(typ)
+    if "bool" in t:
+        v = value.strip().lower()
+        if v in ("1", "true", "yes", "on"):
+            return True
+        if v in ("0", "false", "no", "off"):
+            return False
+        raise ValueError(f"not a boolean: {value!r}")
+    if "int" in t:
+        return int(value)
+    if "float" in t:
+        return float(value)
+    return value

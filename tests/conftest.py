@@ -1,0 +1,15 @@
+"""Test configuration: force an 8-device virtual CPU mesh before JAX loads.
+
+This is the TPU-world analogue of "test multi-node without a cluster"
+(SURVEY.md §4.3): sharding specs, TP decode and collective layouts are
+exercised on 8 virtual CPU devices; real-TPU execution is covered by the
+driver's bench run.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

@@ -1,0 +1,40 @@
+import pytest
+
+from mcpx.core.config import MCPXConfig
+from mcpx.core.errors import ConfigError
+
+
+def test_defaults_validate():
+    MCPXConfig().validate()
+
+
+def test_from_dict_and_unknown_key():
+    cfg = MCPXConfig.from_dict({"engine": {"max_batch_size": 8}})
+    assert cfg.engine.max_batch_size == 8
+    with pytest.raises(ConfigError, match="unknown key"):
+        MCPXConfig.from_dict({"engine": {"nope": 1}})
+
+
+def test_env_overrides():
+    cfg = MCPXConfig.from_env(
+        {
+            "MCPX_ENGINE_MAX_BATCH_SIZE": "16",
+            "MCPX_ENGINE_USE_PALLAS": "false",
+            "MCPX_ENGINE_TEMPERATURE": "0.7",
+            "REDIS_URL": "redis://x:6379/0",
+        }
+    )
+    assert cfg.engine.max_batch_size == 16
+    assert cfg.engine.use_pallas is False
+    assert cfg.engine.temperature == 0.7
+    assert cfg.registry.redis_url == "redis://x:6379/0"
+
+
+def test_invalid_page_size_rejected():
+    with pytest.raises(ConfigError, match="power of two"):
+        MCPXConfig.from_dict({"engine": {"kv_page_size": 13}})
+
+
+def test_invalid_planner_kind_rejected():
+    with pytest.raises(ConfigError, match="planner.kind"):
+        MCPXConfig.from_dict({"planner": {"kind": "oracle"}})

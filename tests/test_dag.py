@@ -1,0 +1,101 @@
+"""Unit tests for the canonical DAG IR (SURVEY.md §4.1)."""
+
+import pytest
+
+from mcpx.core.dag import DagEdge, DagNode, Plan, PlanValidationError, linear_plan
+
+
+def test_linear_plan_generations():
+    p = linear_plan(["a", "b", "c"])
+    assert p.topological_generations() == [["a"], ["b"], ["c"]]
+
+
+def test_fan_out_fan_in_generations():
+    p = Plan(
+        nodes=[DagNode(name=n) for n in ["src", "l", "r", "sink"]],
+        edges=[
+            DagEdge("src", "l"),
+            DagEdge("src", "r"),
+            DagEdge("l", "sink"),
+            DagEdge("r", "sink"),
+        ],
+    )
+    p.validate()
+    assert p.topological_generations() == [["src"], ["l", "r"], ["sink"]]
+
+
+def test_cycle_detected():
+    p = Plan(
+        nodes=[DagNode(name=n) for n in ["a", "b"]],
+        edges=[DagEdge("a", "b"), DagEdge("b", "a")],
+    )
+    with pytest.raises(PlanValidationError, match="cycle"):
+        p.validate()
+
+
+def test_duplicate_node_names_rejected():
+    p = Plan(nodes=[DagNode(name="a"), DagNode(name="a")])
+    with pytest.raises(PlanValidationError, match="duplicate"):
+        p.validate()
+
+
+def test_dangling_edge_rejected():
+    p = Plan(nodes=[DagNode(name="a")], edges=[DagEdge("a", "ghost")])
+    with pytest.raises(PlanValidationError, match="unknown node 'ghost'"):
+        p.validate()
+
+
+def test_self_loop_rejected():
+    p = Plan(nodes=[DagNode(name="a")], edges=[DagEdge("a", "a")])
+    with pytest.raises(PlanValidationError, match="self-loop"):
+        p.validate()
+
+
+def test_reference_wire_format_roundtrip():
+    # The orchestrator envelope of the reference (control_plane.py:96-100).
+    wire = {
+        "nodes": [
+            {"name": "fetch", "endpoint": "http://svc/fetch", "inputs": {"q": "query"}},
+            {"name": "summarize", "endpoint": "http://svc/sum", "inputs": {"text": "fetch"}},
+        ],
+        "edges": [{"from": "fetch", "to": "summarize", "fallback": "http://backup/sum"}],
+    }
+    p = Plan.from_wire(wire)
+    assert [n.name for n in p.nodes] == ["fetch", "summarize"]
+    # Edge-level fallback (reference shape) folds into the dst node's ordered chain.
+    assert p.node("summarize").fallbacks == ["http://backup/sum"]
+    out = p.to_wire()
+    assert out["nodes"][0]["name"] == "fetch"
+    assert out["edges"][0]["from"] == "fetch"
+
+
+def test_planner_steps_shape_normalised():
+    # The step-list shape the reference prompt requests (control_plane.py:61-62).
+    wire = {
+        "steps": [
+            {"service_name": "a", "input_keys": ["query"], "next_steps": ["b"]},
+            {"service_name": "b", "input_keys": {"text": "a"}, "fallback": "http://fb/b"},
+        ]
+    }
+    p = Plan.from_wire(wire)
+    assert p.topological_generations() == [["a"], ["b"]]
+    assert p.node("a").inputs == {"query": "query"}
+    assert p.node("b").inputs == {"text": "a"}
+    assert p.node("b").fallbacks == ["http://fb/b"]
+
+
+def test_from_json_invalid_json():
+    with pytest.raises(PlanValidationError, match="invalid JSON"):
+        Plan.from_json("not json {")
+
+
+def test_bad_inputs_type_listed_in_problems():
+    with pytest.raises(PlanValidationError) as ei:
+        Plan.from_wire({"nodes": [{"name": "a", "inputs": {"x": 3}}], "edges": []})
+    assert any("inputs" in p for p in ei.value.problems)
+
+
+def test_predecessors():
+    p = linear_plan(["a", "b", "c"])
+    assert p.predecessors("c") == ["b"]
+    assert p.predecessors("a") == []
