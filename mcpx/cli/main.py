@@ -1,0 +1,108 @@
+"""CLI: ``python -m mcpx.cli`` — serve the control plane, manage registries.
+
+Replaces the reference's bare ``uvicorn.run`` dev block
+(``control_plane.py:155-157``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+from mcpx.core.config import MCPXConfig
+
+
+def _load_config(args: argparse.Namespace) -> MCPXConfig:
+    if args.config:
+        cfg = MCPXConfig.from_file(args.config)
+    else:
+        cfg = MCPXConfig.from_env()
+    if args.registry_file:
+        cfg.registry.backend = "file"
+        cfg.registry.file_path = args.registry_file
+    if args.planner:
+        cfg.planner.kind = args.planner
+    return cfg
+
+
+def cmd_serve(args: argparse.Namespace) -> int:
+    from aiohttp import web
+
+    from mcpx.server.app import build_app
+    from mcpx.server.factory import build_control_plane
+
+    cfg = _load_config(args)
+    if args.port:
+        cfg.server.port = args.port
+    cp = build_control_plane(cfg)
+    app = build_app(cp)
+    web.run_app(app, host=cfg.server.host, port=cfg.server.port)
+    return 0
+
+
+def cmd_validate(args: argparse.Namespace) -> int:
+    """Validate a plan JSON file against the DAG schema."""
+    from mcpx.core.dag import Plan, PlanValidationError
+
+    if args.file == "-":
+        text = sys.stdin.read()
+    else:
+        try:
+            with open(args.file) as f:
+                text = f.read()
+        except OSError as e:
+            print(json.dumps({"valid": False, "problems": [f"cannot read {args.file}: {e}"]}))
+            return 1
+    try:
+        plan = Plan.from_json(text)
+    except PlanValidationError as e:
+        print(json.dumps({"valid": False, "problems": e.problems}, indent=2))
+        return 1
+    print(
+        json.dumps(
+            {"valid": True, "generations": plan.topological_generations()}, indent=2
+        )
+    )
+    return 0
+
+
+def cmd_gen_registry(args: argparse.Namespace) -> int:
+    """Generate a synthetic N-service registry file (benchmarks)."""
+    from mcpx.utils.synth import synth_registry
+
+    records = synth_registry(args.n, seed=args.seed)
+    with open(args.out, "w") as f:
+        json.dump([r.to_dict() for r in records], f, indent=2)
+    print(f"wrote {len(records)} services to {args.out}")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="mcpx")
+    parser.add_argument("--config", help="JSON config file")
+    parser.add_argument("--registry-file", help="service registry JSON file")
+    parser.add_argument("--planner", choices=["llm", "heuristic", "mock"])
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p_serve = sub.add_parser("serve", help="run the control-plane server")
+    p_serve.add_argument("--port", type=int, default=0)
+    p_serve.set_defaults(func=cmd_serve)
+
+    p_val = sub.add_parser("validate", help="validate a plan JSON file")
+    p_val.add_argument("file", help="path or - for stdin")
+    p_val.set_defaults(func=cmd_validate)
+
+    p_gen = sub.add_parser("gen-registry", help="generate a synthetic registry")
+    p_gen.add_argument("n", type=int)
+    p_gen.add_argument("--out", default="registry.json")
+    p_gen.add_argument("--seed", type=int, default=0)
+    p_gen.set_defaults(func=cmd_gen_registry)
+
+    args = parser.parse_args(argv)
+    return args.func(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

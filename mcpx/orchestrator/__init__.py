@@ -1,0 +1,18 @@
+from mcpx.orchestrator.executor import ExecuteResult, Orchestrator
+from mcpx.orchestrator.transport import (
+    AioHttpTransport,
+    LocalTransport,
+    RouterTransport,
+    Transport,
+    TransportError,
+)
+
+__all__ = [
+    "Orchestrator",
+    "ExecuteResult",
+    "Transport",
+    "TransportError",
+    "AioHttpTransport",
+    "LocalTransport",
+    "RouterTransport",
+]

@@ -1,0 +1,212 @@
+"""Concurrent DAG executor with retry budgets, ordered fallbacks and traces.
+
+Replaces the reference's serial topological walk (reference
+``control_plane.py:93-131``) and fixes its documented bugs:
+
+  - independent nodes in the same topological generation run concurrently
+    under ``asyncio.gather`` (the reference is serial even for parallel
+    branches, ``control_plane.py:104``);
+  - per-node retry budget with exponential backoff (``README.md:49`` promises
+    retries; the code has none — SURVEY.md §2.1 #10), then an *ordered*
+    fallback-endpoint chain (the reference's single edge-fallback lookup
+    crashes, bug B2 at ``control_plane.py:119``);
+  - ``errors`` records only *final* failures; per-attempt history lives in
+    the structured trace (bug B4: the reference leaves a stale error after a
+    fallback succeeds, ``control_plane.py:114,125``);
+  - a failed node *skips* its dependents but never aborts the walk: the
+    response reports partial results (bug B5: the reference raises 502
+    mid-walk and discards everything, ``control_plane.py:130``).
+
+Input wiring preserves reference semantics (``control_plane.py:107``): each
+declared input key resolves from accumulated upstream ``results`` first, then
+the request ``payload``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from mcpx.core.config import OrchestratorConfig
+from mcpx.core.dag import DagNode, Plan
+from mcpx.core.trace import ExecutionTrace, NodeAttempt
+from mcpx.orchestrator.transport import Transport, TransportError
+from mcpx.registry.base import RegistryBackend
+from mcpx.telemetry.metrics import Metrics
+from mcpx.telemetry.stats import TelemetryStore
+
+
+@dataclass
+class ExecuteResult:
+    results: dict[str, Any] = field(default_factory=dict)
+    errors: dict[str, str] = field(default_factory=dict)
+    trace: Optional[ExecutionTrace] = None
+    status: str = "ok"  # ok | partial | failed
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "results": self.results,
+            "errors": self.errors,
+            "status": self.status,
+            **({"trace": self.trace.to_dict()} if self.trace else {}),
+        }
+
+
+class Orchestrator:
+    def __init__(
+        self,
+        transport: Transport,
+        config: Optional[OrchestratorConfig] = None,
+        *,
+        registry: Optional[RegistryBackend] = None,
+        telemetry: Optional[TelemetryStore] = None,
+        metrics: Optional[Metrics] = None,
+    ) -> None:
+        self._transport = transport
+        self._cfg = config or OrchestratorConfig()
+        self._registry = registry
+        self._telemetry = telemetry
+        self._metrics = metrics
+        self._sem = asyncio.Semaphore(self._cfg.max_node_concurrency)
+
+    async def execute(
+        self,
+        plan: Plan,
+        payload: dict[str, Any],
+        trace: Optional[ExecutionTrace] = None,
+    ) -> ExecuteResult:
+        plan.validate()
+        trace = trace or ExecutionTrace()
+        results: dict[str, Any] = {}
+        errors: dict[str, str] = {}
+        failed: set[str] = set()  # failed or skipped node names
+
+        with trace.span("execute"):
+            for generation in plan.topological_generations():
+                runnable: list[DagNode] = []
+                for name in generation:
+                    node = plan.node(name)
+                    bad_preds = [p for p in plan.predecessors(name) if p in failed]
+                    if bad_preds:
+                        failed.add(name)
+                        errors[name] = f"skipped: upstream failed ({', '.join(sorted(bad_preds))})"
+                        nt = trace.node(name, node.service)
+                        nt.status = "skipped"
+                        continue
+                    runnable.append(node)
+                if not runnable:
+                    continue
+                outcomes = await asyncio.gather(
+                    *(self._run_node(node, results, payload, trace) for node in runnable)
+                )
+                for node, (ok, value) in zip(runnable, outcomes):
+                    if ok:
+                        results[node.name] = value
+                    else:
+                        failed.add(node.name)
+                        errors[node.name] = value
+
+        trace.finish()
+        if not errors:
+            status = "ok"
+        elif results:
+            status = "partial"
+        else:
+            status = "failed"
+        return ExecuteResult(results=results, errors=errors, trace=trace, status=status)
+
+    # ------------------------------------------------------------------ node
+    async def _run_node(
+        self,
+        node: DagNode,
+        results: dict[str, Any],
+        payload: dict[str, Any],
+        trace: ExecutionTrace,
+    ) -> tuple[bool, Any]:
+        """Returns ``(True, response)`` or ``(False, final_error_message)``."""
+        nt = trace.node(node.name, node.service)
+        nt.started_at = asyncio.get_event_loop().time()
+
+        endpoint, fallbacks = await self._resolve_endpoints(node)
+        if not endpoint:
+            nt.status = "failed"
+            nt.finished_at = asyncio.get_event_loop().time()
+            return False, f"no endpoint for service '{node.service}'"
+
+        body = dict(node.params)
+        for param, src in node.inputs.items():
+            if src in results:
+                body[param] = results[src]
+            elif src in payload:
+                body[param] = payload[src]
+
+        # Attempt chain: primary × (retries+1) with backoff, then each
+        # fallback endpoint once, in declared order (reference README.md:49
+        # "ordered fallbacks", finally implemented).
+        attempts: list[tuple[str, str]] = [("primary", endpoint)]
+        attempts += [("retry", endpoint)] * node.retries
+        attempts += [("fallback", fb) for fb in fallbacks]
+
+        last_error = ""
+        backoff = self._cfg.retry_backoff_s
+        for i, (kind, url) in enumerate(attempts):
+            if kind == "retry" and backoff > 0:
+                await asyncio.sleep(backoff)
+                backoff *= self._cfg.retry_backoff_multiplier
+            t0 = asyncio.get_event_loop().time()
+            try:
+                async with self._sem:
+                    response = await self._transport.post(url, body, node.timeout_s)
+                latency_ms = (asyncio.get_event_loop().time() - t0) * 1e3
+                nt.attempts.append(
+                    NodeAttempt(endpoint=url, kind=kind, status="ok", latency_ms=latency_ms)
+                )
+                self._record(node.service, latency_ms, ok=True)
+                nt.status = "ok"
+                nt.finished_at = asyncio.get_event_loop().time()
+                return True, response
+            except TransportError as e:
+                latency_ms = (asyncio.get_event_loop().time() - t0) * 1e3
+                status = "timeout" if e.timeout else "error"
+                nt.attempts.append(
+                    NodeAttempt(
+                        endpoint=url, kind=kind, status=status, latency_ms=latency_ms,
+                        error=str(e),
+                    )
+                )
+                self._record(node.service, latency_ms, ok=False)
+                last_error = str(e)
+
+        nt.status = "failed"
+        nt.finished_at = asyncio.get_event_loop().time()
+        return False, last_error or "all attempts failed"
+
+    async def _resolve_endpoints(self, node: DagNode) -> tuple[str, list[str]]:
+        """Endpoint resolution: the plan's endpoint if set, else the registry
+        record (endpoints are control-plane data, never trusted from LLM
+        output — SURVEY.md §2.4 build decision). Registry-declared fallbacks
+        (README.md:94) are appended after plan-declared ones."""
+        endpoint = node.endpoint
+        fallbacks = list(node.fallbacks)
+        if self._registry is not None:
+            record = await self._registry.get(node.service)
+            if record is not None:
+                if not endpoint:
+                    endpoint = record.endpoint
+                for fb in record.fallbacks:
+                    if fb not in fallbacks:
+                        fallbacks.append(fb)
+        return endpoint, fallbacks
+
+    async def aclose(self) -> None:
+        """Release transport resources (HTTP sessions)."""
+        await self._transport.close()
+
+    def _record(self, service: str, latency_ms: float, *, ok: bool) -> None:
+        if self._telemetry is not None:
+            self._telemetry.record(service, latency_ms=latency_ms, ok=ok)
+        if self._metrics is not None:
+            self._metrics.service_calls.labels(
+                service=service, status="ok" if ok else "error"
+            ).inc()

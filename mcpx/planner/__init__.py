@@ -1,0 +1,5 @@
+from mcpx.planner.base import Planner, PlanContext
+from mcpx.planner.mock import MockPlanner
+from mcpx.planner.heuristic import HeuristicPlanner
+
+__all__ = ["Planner", "PlanContext", "MockPlanner", "HeuristicPlanner"]

@@ -1,0 +1,33 @@
+"""Planner interface: intent → validated Plan.
+
+The reference's planner is a single blocking method gluing Redis scan +
+prompt + OpenAI + ``json.loads`` (reference ``control_plane.py:57-75``).
+Here planning is async (the reference blocks the event loop, bug B6), takes
+an explicit context (registry + telemetry snapshot) instead of reaching into
+global singletons, and must return a *validated* ``Plan`` — planners are
+responsible for their own retry/repair loops (bug B7).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Protocol, runtime_checkable
+
+from mcpx.core.dag import Plan
+from mcpx.registry.base import RegistryBackend
+from mcpx.telemetry.stats import ServiceStats
+
+
+@dataclass
+class PlanContext:
+    registry: RegistryBackend
+    telemetry: dict[str, ServiceStats] = field(default_factory=dict)
+    # Services the retrieval layer shortlisted for this intent (names, ranked).
+    shortlist: Optional[list[str]] = None
+    # Services a replan must avoid (observed failing in this request).
+    exclude: set[str] = field(default_factory=set)
+
+
+@runtime_checkable
+class Planner(Protocol):
+    async def plan(self, intent: str, context: PlanContext) -> Plan: ...

@@ -1,0 +1,75 @@
+"""JSON-file registry backend: a list of service records on disk.
+
+Useful for benchmarks and reproducible demos; loads lazily on first access
+(no import-time I/O — reference bug B8 is the cautionary tale).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+from typing import Optional
+
+from mcpx.core.errors import RegistryError
+from mcpx.registry.base import RegistryBackend, ServiceRecord
+from mcpx.registry.memory import InMemoryRegistry
+
+
+class FileRegistry(RegistryBackend):
+    def __init__(self, path: str) -> None:
+        self._path = path
+        self._mem = InMemoryRegistry()
+        self._loaded = False
+
+    async def _ensure_loaded(self) -> None:
+        if self._loaded:
+            return
+        if not os.path.exists(self._path):
+            raise RegistryError(f"registry file not found: {self._path}")
+        try:
+            with open(self._path) as f:
+                data = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            raise RegistryError(f"cannot read registry file {self._path}: {e}") from e
+        if not isinstance(data, list):
+            raise RegistryError(f"registry file {self._path} must hold a JSON list")
+        for obj in data:
+            await self._mem.put(ServiceRecord.from_dict(obj))
+        self._loaded = True
+
+    async def get(self, name: str) -> Optional[ServiceRecord]:
+        await self._ensure_loaded()
+        return await self._mem.get(name)
+
+    async def put(self, record: ServiceRecord) -> None:
+        await self._ensure_loaded()
+        await self._mem.put(record)
+        await self._flush()
+
+    async def delete(self, name: str) -> bool:
+        await self._ensure_loaded()
+        existed = await self._mem.delete(name)
+        if existed:
+            await self._flush()
+        return existed
+
+    async def list_services(self) -> list[ServiceRecord]:
+        await self._ensure_loaded()
+        return await self._mem.list_services()
+
+    async def version(self) -> int:
+        await self._ensure_loaded()
+        return await self._mem.version()
+
+    async def _flush(self) -> None:
+        records = [r.to_dict() for r in await self._mem.list_services()]
+
+        def write() -> None:
+            tmp = self._path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(records, f, indent=2)
+            os.replace(tmp, self._path)
+
+        # Off the event loop: a large registry write must not stall requests.
+        await asyncio.to_thread(write)

@@ -1,0 +1,67 @@
+"""Redis registry backend — wire-compatible with the reference's key layout.
+
+Records live at ``<prefix><name>`` as JSON values (reference
+``control_plane.py:20,33-34``: prefix ``mcp:service:``), so a registry
+populated for the reference is readable as-is. The ``redis`` package is an
+optional dependency; the import is deferred so the rest of the framework never
+needs it (the reference's eager connections are bug B8).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from mcpx.core.errors import RegistryError
+from mcpx.registry.base import RegistryBackend, ServiceRecord
+
+
+class RedisRegistry(RegistryBackend):
+    def __init__(self, url: str, prefix: str = "mcp:service:") -> None:
+        self._url = url
+        self._prefix = prefix
+        self._client = None
+        self._version_key = f"{prefix.rstrip(':')}:__version__"
+
+    def _redis(self):
+        if self._client is None:
+            try:
+                import redis.asyncio as aioredis  # type: ignore
+            except ImportError as e:  # pragma: no cover - env without redis
+                raise RegistryError(
+                    "registry.backend=redis requires the 'redis' package, which is not installed"
+                ) from e
+            self._client = aioredis.from_url(self._url)
+        return self._client
+
+    async def get(self, name: str) -> Optional[ServiceRecord]:
+        raw = await self._redis().get(self._prefix + name)
+        return ServiceRecord.from_dict(json.loads(raw)) if raw else None
+
+    async def put(self, record: ServiceRecord) -> None:
+        r = self._redis()
+        await r.set(self._prefix + record.name, json.dumps(record.to_dict()))
+        await r.incr(self._version_key)
+
+    async def delete(self, name: str) -> bool:
+        r = self._redis()
+        n = await r.delete(self._prefix + name)
+        if n:
+            await r.incr(self._version_key)
+        return bool(n)
+
+    async def list_services(self) -> list[ServiceRecord]:
+        r = self._redis()
+        records: list[ServiceRecord] = []
+        async for key in r.scan_iter(match=self._prefix + "*"):
+            k = key.decode() if isinstance(key, bytes) else key
+            if k == self._version_key:
+                continue
+            raw = await r.get(k)
+            if raw:
+                records.append(ServiceRecord.from_dict(json.loads(raw)))
+        return sorted(records, key=lambda rec: rec.name)
+
+    async def version(self) -> int:
+        v = await self._redis().get(self._version_key)
+        return int(v or 0)

@@ -1,0 +1,4 @@
+from mcpx.server.control import ControlPlane
+from mcpx.server.app import build_app
+
+__all__ = ["ControlPlane", "build_app"]

@@ -1,0 +1,223 @@
+"""HTTP API surface (aiohttp) — wire-compatible with the reference.
+
+Endpoints (reference ``control_plane.py:133-151``):
+  POST /plan              {"intent": str} -> {"graph": {...}, "explanation", ...}
+  POST /execute           {"graph": {...}, "payload": {...}} -> {"results", "errors", ...}
+  POST /plan_and_execute  {"intent": str, "payload": {...}} -> plan + execution
+
+plus the subsystems the reference only advertises:
+  GET  /metrics    Prometheus text exposition (README.md:43-44, made real)
+  GET  /healthz    liveness + engine readiness
+  GET  /telemetry  per-service rolling stats snapshot
+  GET/POST /services, GET/DELETE /services/{name}   registry CRUD
+             (the reference has no registration API at all, README.md:86)
+
+Handlers are thin JSON shims over ``ControlPlane``; every request gets a
+trace ID and latency metrics. Fully async — planning never blocks the event
+loop (reference bug B6).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import Any
+
+from aiohttp import web
+
+from mcpx.core.dag import Plan, PlanValidationError
+from mcpx.core.errors import PlannerError, RegistryError
+from mcpx.registry.base import ServiceRecord
+from mcpx.server.control import ControlPlane
+
+
+def _json_error(status: int, message: str, **extra: Any) -> web.Response:
+    return web.json_response({"error": message, **extra}, status=status)
+
+
+async def _body(request: web.Request) -> dict[str, Any]:
+    try:
+        obj = await request.json()
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        raise web.HTTPBadRequest(
+            text=json.dumps({"error": f"invalid JSON body: {e}"}),
+            content_type="application/json",
+        )
+    if not isinstance(obj, dict):
+        raise web.HTTPBadRequest(
+            text=json.dumps({"error": "request body must be a JSON object"}),
+            content_type="application/json",
+        )
+    return obj
+
+
+CONTROL_PLANE_KEY: web.AppKey[ControlPlane] = web.AppKey("control_plane", ControlPlane)
+TRACE_ID_KEY = "mcpx_trace_id"
+
+# Endpoints subject to the server.max_concurrency admission limit (the
+# planning/execution paths; observability and CRUD stay always-available).
+_LIMITED = {"/plan", "/execute", "/plan_and_execute"}
+
+
+def build_app(cp: ControlPlane) -> web.Application:
+    metrics = cp.metrics
+    server_cfg = cp.config.server
+    inflight = {"n": 0}
+
+    @web.middleware
+    async def observability(request: web.Request, handler) -> web.StreamResponse:
+        """Every request: trace ID, latency histogram, request counter,
+        admission control (429) and a hard request timeout (504)."""
+        from mcpx.core.trace import new_trace_id
+
+        # Label by route template, not raw path: bounded metric cardinality.
+        resource = getattr(request.match_info.route, "resource", None)
+        endpoint = resource.canonical if resource is not None else "unmatched"
+        trace_id = new_trace_id()
+        request[TRACE_ID_KEY] = trace_id
+        t0 = time.monotonic()
+        status = "error"
+        limited = request.path in _LIMITED
+        try:
+            if limited and inflight["n"] >= server_cfg.max_concurrency:
+                status = "throttled"
+                return web.json_response(
+                    {"error": "server at max concurrency, retry later"}, status=429
+                )
+            if limited:
+                inflight["n"] += 1
+            try:
+                resp = await asyncio.wait_for(
+                    handler(request), timeout=server_cfg.request_timeout_s
+                )
+            except asyncio.TimeoutError:
+                status = "timeout"
+                return web.json_response(
+                    {"error": f"request exceeded {server_cfg.request_timeout_s}s"},
+                    status=504,
+                )
+            finally:
+                if limited:
+                    inflight["n"] -= 1
+            status = "ok" if resp.status < 400 else "error"
+            resp.headers["X-Trace-Id"] = trace_id
+            return resp
+        finally:
+            metrics.requests.labels(endpoint=endpoint, status=status).inc()
+            metrics.request_latency.labels(endpoint=endpoint).observe(time.monotonic() - t0)
+
+    app = web.Application(client_max_size=16 * 1024 * 1024, middlewares=[observability])
+    app[CONTROL_PLANE_KEY] = cp
+
+    # ------------------------------------------------------------------ plan
+    async def plan(request: web.Request) -> web.Response:
+        body = await _body(request)
+        intent = body.get("intent")
+        if not isinstance(intent, str) or not intent.strip():
+            return _json_error(400, "'intent' must be a non-empty string")
+        try:
+            p, latency_ms = await cp.plan(intent)
+        except PlannerError as e:
+            return _json_error(422, f"planning failed: {e}")
+        return web.json_response(
+            {
+                "graph": p.to_wire(),
+                "explanation": p.explanation,
+                "latency_ms": round(latency_ms, 3),
+            }
+        )
+
+    # --------------------------------------------------------------- execute
+    async def execute(request: web.Request) -> web.Response:
+        body = await _body(request)
+        graph = body.get("graph")
+        payload = body.get("payload", {})
+        if payload is None:
+            payload = {}
+        if not isinstance(graph, dict):
+            return _json_error(400, "'graph' must be an object")
+        if not isinstance(payload, dict):
+            return _json_error(400, "'payload' must be an object")
+        try:
+            plan_obj = Plan.from_wire(graph)
+        except PlanValidationError as e:
+            return _json_error(422, "invalid graph", problems=e.problems)
+        result = await cp.execute(plan_obj, payload)
+        return web.json_response(result.to_dict())
+
+    # ------------------------------------------------------ plan_and_execute
+    async def plan_and_execute(request: web.Request) -> web.Response:
+        body = await _body(request)
+        intent = body.get("intent")
+        payload = body.get("payload", {})
+        if payload is None:
+            payload = {}
+        if not isinstance(intent, str) or not intent.strip():
+            return _json_error(400, "'intent' must be a non-empty string")
+        if not isinstance(payload, dict):
+            return _json_error(400, "'payload' must be an object")
+        try:
+            out = await cp.plan_and_execute(intent, payload)
+        except PlannerError as e:
+            return _json_error(422, f"planning failed: {e}")
+        return web.json_response(out)
+
+    # -------------------------------------------------------------- registry
+    async def list_services(request: web.Request) -> web.Response:
+        records = await cp.registry.list_services()
+        return web.json_response(
+            {"services": [r.to_dict() for r in records], "version": await cp.registry.version()}
+        )
+
+    async def register_service(request: web.Request) -> web.Response:
+        body = await _body(request)
+        try:
+            record = ServiceRecord.from_dict(body)
+        except RegistryError as e:
+            return _json_error(400, str(e))
+        await cp.registry.put(record)
+        return web.json_response({"registered": record.name}, status=201)
+
+    async def get_service(request: web.Request) -> web.Response:
+        record = await cp.registry.get(request.match_info["name"])
+        if record is None:
+            return _json_error(404, f"no such service '{request.match_info['name']}'")
+        return web.json_response(record.to_dict())
+
+    async def delete_service(request: web.Request) -> web.Response:
+        existed = await cp.registry.delete(request.match_info["name"])
+        if not existed:
+            return _json_error(404, f"no such service '{request.match_info['name']}'")
+        return web.json_response({"deleted": request.match_info["name"]})
+
+    # --------------------------------------------------------- observability
+    async def metrics_handler(request: web.Request) -> web.Response:
+        return web.Response(body=cp.metrics.render(), content_type="text/plain", charset="utf-8")
+
+    async def telemetry_handler(request: web.Request) -> web.Response:
+        return web.json_response(
+            {name: s.to_dict() for name, s in cp.telemetry.snapshot().items()}
+        )
+
+    async def healthz(request: web.Request) -> web.Response:
+        engine = getattr(cp.planner, "engine", None)
+        engine_state = getattr(engine, "state", "n/a") if engine is not None else "n/a"
+        return web.json_response({"status": "ok", "engine": engine_state})
+
+    app.router.add_post("/plan", plan)
+    app.router.add_post("/execute", execute)
+    app.router.add_post("/plan_and_execute", plan_and_execute)
+    app.router.add_get("/services", list_services)
+    app.router.add_post("/services", register_service)
+    app.router.add_get("/services/{name}", get_service)
+    app.router.add_delete("/services/{name}", delete_service)
+    app.router.add_get("/metrics", metrics_handler)
+    app.router.add_get("/telemetry", telemetry_handler)
+    app.router.add_get("/healthz", healthz)
+
+    async def on_cleanup(app: web.Application) -> None:
+        await cp.orchestrator.aclose()
+
+    app.on_cleanup.append(on_cleanup)
+    return app

@@ -1,0 +1,124 @@
+"""ControlPlane: the use-case layer tying planner, orchestrator, retrieval
+and telemetry together, independent of HTTP.
+
+This is the testable core behind the API surface (the reference fuses this
+into FastAPI handlers over module singletons, ``control_plane.py:133-151``).
+Includes the replan loop (baseline config 4) and an LRU plan cache keyed by
+(intent, registry version) — a large plans/sec lever given immutable
+registries (SURVEY.md §5 checkpoint/resume).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+from typing import Any, Optional
+
+from mcpx.core.config import MCPXConfig
+from mcpx.core.dag import Plan
+from mcpx.core.trace import ExecutionTrace
+from mcpx.orchestrator.executor import ExecuteResult, Orchestrator
+from mcpx.planner.base import PlanContext, Planner
+from mcpx.registry.base import RegistryBackend
+from mcpx.telemetry.metrics import Metrics
+from mcpx.telemetry.replan import ReplanPolicy
+from mcpx.telemetry.stats import TelemetryStore
+
+
+class ControlPlane:
+    def __init__(
+        self,
+        *,
+        config: Optional[MCPXConfig] = None,
+        registry: RegistryBackend,
+        planner: Planner,
+        orchestrator: Orchestrator,
+        telemetry: Optional[TelemetryStore] = None,
+        metrics: Optional[Metrics] = None,
+        retriever: Any = None,  # mcpx.retrieval.Index (duck-typed: async shortlist(intent, k))
+        replan_policy: Optional[ReplanPolicy] = None,
+    ) -> None:
+        self.config = config or MCPXConfig()
+        self.registry = registry
+        self.planner = planner
+        self.orchestrator = orchestrator
+        self.telemetry = telemetry or TelemetryStore(self.config.telemetry.ewma_alpha)
+        self.metrics = metrics or Metrics()
+        self.retriever = retriever
+        self.replan_policy = replan_policy or ReplanPolicy(self.config.telemetry)
+        self._plan_cache: OrderedDict[tuple[str, int], Plan] = OrderedDict()
+
+    # ------------------------------------------------------------------ plan
+    async def plan(self, intent: str, *, use_cache: bool = True) -> tuple[Plan, float]:
+        """Plan an intent; returns (plan, latency_ms)."""
+        t0 = time.monotonic()
+        version = await self.registry.version()
+        key = (intent, version)
+        if use_cache and self.config.planner.plan_cache_size > 0:
+            cached = self._plan_cache.get(key)
+            if cached is not None:
+                self._plan_cache.move_to_end(key)
+                self.metrics.plan_cache.labels(result="hit").inc()
+                return cached, (time.monotonic() - t0) * 1e3
+            self.metrics.plan_cache.labels(result="miss").inc()
+
+        context = await self._context(intent)
+        try:
+            plan = await self.planner.plan(intent, context)
+            self.metrics.plans.labels(planner=type(self.planner).__name__, status="ok").inc()
+        except Exception:
+            self.metrics.plans.labels(planner=type(self.planner).__name__, status="error").inc()
+            raise
+        if use_cache and self.config.planner.plan_cache_size > 0:
+            self._plan_cache[key] = plan
+            while len(self._plan_cache) > self.config.planner.plan_cache_size:
+                self._plan_cache.popitem(last=False)
+        return plan, (time.monotonic() - t0) * 1e3
+
+    async def _context(self, intent: str, exclude: Optional[set[str]] = None) -> PlanContext:
+        shortlist = None
+        if self.retriever is not None:
+            shortlist = await self.retriever.shortlist(intent, self.config.planner.shortlist_top_k)
+        return PlanContext(
+            registry=self.registry,
+            telemetry=self.telemetry.snapshot(),
+            shortlist=shortlist,
+            exclude=exclude or set(),
+        )
+
+    # --------------------------------------------------------------- execute
+    async def execute(
+        self, plan: Plan, payload: dict[str, Any], trace: Optional[ExecutionTrace] = None
+    ) -> ExecuteResult:
+        return await self.orchestrator.execute(plan, payload, trace)
+
+    # ------------------------------------------------------- plan_and_execute
+    async def plan_and_execute(self, intent: str, payload: dict[str, Any]) -> dict[str, Any]:
+        """Plan, execute, and adaptively replan around observed failures
+        (bounded by ``telemetry.max_replans``)."""
+        trace = ExecutionTrace()
+        plan, _ = await self.plan(intent)
+        result = await self.execute(plan, payload, trace)
+        exclude: set[str] = set()
+        while result.status != "ok" and trace.replans < self.replan_policy.max_replans:
+            records = {r.name: r for r in await self.registry.list_services()}
+            decision = self.replan_policy.assess(plan, result, self.telemetry, records)
+            if not decision.should_replan:
+                break
+            exclude |= decision.exclude
+            self.metrics.replans.inc()
+            trace.replans += 1
+            context = await self._context(intent, exclude)
+            try:
+                plan = await self.planner.plan(intent, context)
+            except Exception:
+                break  # nothing viable left to route around; keep last result
+            result = await self.execute(plan, payload, trace)
+        return {
+            "graph": plan.to_wire(),
+            "results": result.results,
+            "errors": result.errors,
+            "status": result.status,
+            "replans": trace.replans,
+            "trace": result.trace.to_dict() if result.trace else None,
+        }

@@ -1,0 +1,68 @@
+"""Application factory: config → fully wired ControlPlane.
+
+All construction is lazy and injected — nothing touches the network or the
+TPU at import time (the reference connects to Postgres at import, bug B8).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from mcpx.core.config import MCPXConfig
+from mcpx.orchestrator.executor import Orchestrator
+from mcpx.orchestrator.transport import RouterTransport, Transport
+from mcpx.planner.base import Planner
+from mcpx.planner.heuristic import HeuristicPlanner
+from mcpx.planner.mock import MockPlanner
+from mcpx.registry import make_registry
+from mcpx.registry.base import RegistryBackend
+from mcpx.server.control import ControlPlane
+from mcpx.telemetry.metrics import Metrics
+from mcpx.telemetry.replan import ReplanPolicy
+from mcpx.telemetry.stats import TelemetryStore
+
+
+def build_control_plane(
+    config: Optional[MCPXConfig] = None,
+    *,
+    registry: Optional[RegistryBackend] = None,
+    planner: Optional[Planner] = None,
+    transport: Optional[Transport] = None,
+    retriever=None,
+) -> ControlPlane:
+    config = config or MCPXConfig()
+    config.validate()
+    registry = registry if registry is not None else make_registry(config.registry)
+    transport = transport if transport is not None else RouterTransport()
+    telemetry = TelemetryStore(config.telemetry.ewma_alpha)
+    metrics = Metrics()
+    orchestrator = Orchestrator(
+        transport,
+        config.orchestrator,
+        registry=registry,
+        telemetry=telemetry,
+        metrics=metrics,
+    )
+    if planner is None:
+        if config.planner.kind == "heuristic":
+            planner = HeuristicPlanner(config.planner)
+        elif config.planner.kind == "mock":
+            planner = MockPlanner()
+        else:  # "llm"
+            try:
+                from mcpx.planner.llm import LLMPlanner  # deferred: pulls in JAX
+            except ImportError as e:
+                from mcpx.core.errors import ConfigError
+
+                raise ConfigError(f"planner.kind=llm unavailable: {e}") from e
+            planner = LLMPlanner.from_config(config, retriever=retriever)
+    return ControlPlane(
+        config=config,
+        registry=registry,
+        planner=planner,
+        orchestrator=orchestrator,
+        telemetry=telemetry,
+        metrics=metrics,
+        retriever=retriever,
+        replan_policy=ReplanPolicy(config.telemetry),
+    )

@@ -1,0 +1,4 @@
+from mcpx.telemetry.stats import ServiceStats, TelemetryStore
+from mcpx.telemetry.metrics import Metrics
+
+__all__ = ["ServiceStats", "TelemetryStore", "Metrics"]

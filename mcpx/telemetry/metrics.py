@@ -1,0 +1,73 @@
+"""Prometheus-scrapeable metrics for the control plane.
+
+Implements the reference README's advertised-but-absent telemetry feature
+(reference ``README.md:43-44``) for real: plans/sec, per-endpoint latency
+histograms, batch occupancy and KV-page utilisation gauges, per-service call
+counters — exposed in Prometheus text format at ``GET /metrics``.
+
+Uses ``prometheus_client`` with an *injected* ``CollectorRegistry`` so many
+app instances (tests!) never collide on the global default registry.
+"""
+
+from __future__ import annotations
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+
+LATENCY_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.075, 0.1, 0.15, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+class Metrics:
+    def __init__(self) -> None:
+        self.registry = CollectorRegistry()
+        self.requests = Counter(
+            "mcpx_requests_total",
+            "API requests",
+            ["endpoint", "status"],
+            registry=self.registry,
+        )
+        self.request_latency = Histogram(
+            "mcpx_request_latency_seconds",
+            "API request latency",
+            ["endpoint"],
+            buckets=LATENCY_BUCKETS,
+            registry=self.registry,
+        )
+        self.plans = Counter(
+            "mcpx_plans_total", "Plans produced", ["planner", "status"], registry=self.registry
+        )
+        self.service_calls = Counter(
+            "mcpx_service_calls_total",
+            "Microservice invocations",
+            ["service", "status"],
+            registry=self.registry,
+        )
+        self.replans = Counter(
+            "mcpx_replans_total", "Telemetry-triggered replans", registry=self.registry
+        )
+        self.plan_cache = Counter(
+            "mcpx_plan_cache_total", "Plan cache lookups", ["result"], registry=self.registry
+        )
+        self.batch_occupancy = Gauge(
+            "mcpx_engine_batch_occupancy",
+            "Decode batch slots in use",
+            registry=self.registry,
+        )
+        self.kv_page_utilization = Gauge(
+            "mcpx_engine_kv_page_utilization",
+            "Fraction of KV pages allocated",
+            registry=self.registry,
+        )
+        self.decode_tokens = Counter(
+            "mcpx_engine_decode_tokens_total", "Tokens decoded", registry=self.registry
+        )
+
+    def render(self) -> bytes:
+        return generate_latest(self.registry)

@@ -1,0 +1,83 @@
+"""Per-service rolling telemetry: EWMA latency, error rate, observed cost.
+
+The reference README advertises "Prometheus → Redis telemetry enabling
+adaptive planning" (reference ``README.md:43-44,81``) but ships zero code for
+it (SURVEY.md §2.1 #9). This store is that feature made real: the
+orchestrator records every attempt; the planner reads ``snapshot()`` to rank
+candidate services by live cost/latency/error-rate; the replan policy
+(``mcpx.telemetry.replan``) reads it to decide when observed behaviour has
+drifted from the plan's assumptions.
+
+Pure in-process and lock-free under asyncio (single event loop writer); a
+Redis-mirroring exporter can be layered on top without changing callers.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass
+class ServiceStats:
+    service: str
+    ewma_latency_ms: float = 0.0
+    ewma_error_rate: float = 0.0
+    ewma_cost: float = 0.0
+    calls: int = 0
+    errors: int = 0
+    last_update: float = 0.0
+
+    def to_dict(self) -> dict:
+        return {
+            "service": self.service,
+            "ewma_latency_ms": round(self.ewma_latency_ms, 3),
+            "ewma_error_rate": round(self.ewma_error_rate, 5),
+            "ewma_cost": round(self.ewma_cost, 5),
+            "calls": self.calls,
+            "errors": self.errors,
+        }
+
+
+class TelemetryStore:
+    def __init__(self, alpha: float = 0.2) -> None:
+        if not 0.0 < alpha <= 1.0:
+            raise ValueError("alpha must be in (0, 1]")
+        self._alpha = alpha
+        self._stats: dict[str, ServiceStats] = {}
+
+    def record(
+        self,
+        service: str,
+        *,
+        latency_ms: float,
+        ok: bool,
+        cost: float = 0.0,
+    ) -> None:
+        s = self._stats.get(service)
+        a = self._alpha
+        if s is None:
+            s = self._stats[service] = ServiceStats(
+                service=service,
+                ewma_latency_ms=latency_ms,
+                ewma_error_rate=0.0 if ok else 1.0,
+                ewma_cost=cost,
+            )
+        else:
+            s.ewma_latency_ms = (1 - a) * s.ewma_latency_ms + a * latency_ms
+            s.ewma_error_rate = (1 - a) * s.ewma_error_rate + a * (0.0 if ok else 1.0)
+            s.ewma_cost = (1 - a) * s.ewma_cost + a * cost
+        s.calls += 1
+        if not ok:
+            s.errors += 1
+        s.last_update = time.monotonic()
+
+    def get(self, service: str) -> Optional[ServiceStats]:
+        return self._stats.get(service)
+
+    def snapshot(self) -> dict[str, ServiceStats]:
+        return dict(self._stats)
+
+    def reset(self) -> None:
+        self._stats.clear()

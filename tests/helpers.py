@@ -1,0 +1,45 @@
+"""Shared test fixtures: scriptable fake microservices over LocalTransport
+(SURVEY.md §4.4 — fault-injecting in-process services)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from mcpx.orchestrator.transport import LocalTransport, TransportError
+
+
+class FakeService:
+    """In-process microservice with scriptable failures.
+
+    ``fail_times``: fail the first N calls, then succeed — exercises retry.
+    ``always_fail``: every call fails — exercises fallbacks/partial results.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        *,
+        fail_times: int = 0,
+        always_fail: bool = False,
+        result: dict[str, Any] | None = None,
+    ) -> None:
+        self.name = name
+        self.calls: list[dict[str, Any]] = []
+        self._fail_times = fail_times
+        self._always_fail = always_fail
+        self._result = result
+
+    async def __call__(self, payload: dict[str, Any]) -> dict[str, Any]:
+        self.calls.append(payload)
+        if self._always_fail or len(self.calls) <= self._fail_times:
+            raise TransportError(f"{self.name} injected failure #{len(self.calls)}")
+        if self._result is not None:
+            return self._result
+        return {"service": self.name, "echo": payload}
+
+
+def make_transport(*services: FakeService, latencies: dict[str, float] | None = None):
+    transport = LocalTransport()
+    for svc in services:
+        transport.register(svc.name, svc, latency_s=(latencies or {}).get(svc.name, 0.0))
+    return transport

@@ -1,0 +1,168 @@
+"""Executor semantics: concurrency, retries, ordered fallbacks, partial
+failure — proving reference bugs B2-B5 are fixed (SURVEY.md §2.5)."""
+
+import asyncio
+import time
+
+from mcpx.core.config import OrchestratorConfig
+from mcpx.core.dag import DagEdge, DagNode, Plan
+from mcpx.orchestrator.executor import Orchestrator
+
+from tests.helpers import FakeService, make_transport
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def orch(transport, **kw):
+    cfg = OrchestratorConfig(retry_backoff_s=0.0)
+    return Orchestrator(transport, cfg, **kw)
+
+
+def test_linear_chain_wires_inputs():
+    a = FakeService("a", result={"doc": "D"})
+    b = FakeService("b")
+    t = make_transport(a, b)
+    plan = Plan(
+        nodes=[
+            DagNode(name="a", endpoint="local://a", inputs={"q": "query"}),
+            DagNode(name="b", endpoint="local://b", inputs={"doc": "a"}),
+        ],
+        edges=[DagEdge("a", "b")],
+    )
+    res = run(orch(t).execute(plan, {"query": "hello"}))
+    assert res.status == "ok"
+    assert a.calls == [{"q": "hello"}]
+    # b's 'doc' input resolves from a's *result* (results-before-payload,
+    # reference control_plane.py:107 semantics).
+    assert b.calls == [{"doc": {"doc": "D"}}]
+    assert res.errors == {}
+
+
+def test_generation_concurrency():
+    # Two independent 60ms nodes must run concurrently (<100ms total), not
+    # serially (>=120ms) — the reference walks serially (control_plane.py:104).
+    l, r = FakeService("l"), FakeService("r")
+    t = make_transport(l, r, latencies={"l": 0.06, "r": 0.06})
+    plan = Plan(
+        nodes=[
+            DagNode(name="l", endpoint="local://l"),
+            DagNode(name="r", endpoint="local://r"),
+        ]
+    )
+    t0 = time.monotonic()
+    res = run(orch(t).execute(plan, {}))
+    elapsed = time.monotonic() - t0
+    assert res.status == "ok"
+    assert elapsed < 0.11, f"parallel generation took {elapsed:.3f}s (serial?)"
+
+
+def test_retry_budget_recovers():
+    flaky = FakeService("flaky", fail_times=2)
+    t = make_transport(flaky)
+    plan = Plan(nodes=[DagNode(name="flaky", endpoint="local://flaky", retries=2)])
+    res = run(orch(t).execute(plan, {}))
+    assert res.status == "ok"
+    assert len(flaky.calls) == 3
+    nt = res.trace.nodes["flaky"]
+    assert [a.kind for a in nt.attempts] == ["primary", "retry", "retry"]
+    assert nt.status == "ok"
+    # B4 fixed: no stale error after recovery.
+    assert res.errors == {}
+
+
+def test_ordered_fallbacks():
+    primary = FakeService("p", always_fail=True)
+    fb1 = FakeService("fb1", always_fail=True)
+    fb2 = FakeService("fb2", result={"ok": True})
+    t = make_transport(primary, fb1, fb2)
+    plan = Plan(
+        nodes=[
+            DagNode(
+                name="n",
+                endpoint="local://p",
+                retries=0,
+                fallbacks=["local://fb1", "local://fb2"],
+            )
+        ]
+    )
+    res = run(orch(t).execute(plan, {}))
+    assert res.status == "ok"
+    assert res.results["n"] == {"ok": True}
+    kinds = [a.kind for a in res.trace.nodes["n"].attempts]
+    assert kinds == ["primary", "fallback", "fallback"]
+
+
+def test_partial_failure_keeps_results_and_skips_dependents():
+    # B5 fixed: root branch failure doesn't discard the sibling branch.
+    good = FakeService("good", result={"v": 1})
+    bad = FakeService("bad", always_fail=True)
+    down = FakeService("down")
+    t = make_transport(good, bad, down)
+    plan = Plan(
+        nodes=[
+            DagNode(name="good", endpoint="local://good"),
+            DagNode(name="bad", endpoint="local://bad", retries=0),
+            DagNode(name="down", endpoint="local://down", inputs={"x": "bad"}),
+        ],
+        edges=[DagEdge("bad", "down")],
+    )
+    res = run(orch(t).execute(plan, {}))
+    assert res.status == "partial"
+    assert res.results["good"] == {"v": 1}
+    assert "bad" in res.errors
+    assert res.errors["down"].startswith("skipped:")
+    assert down.calls == []  # never invoked
+    assert res.trace.nodes["down"].status == "skipped"
+
+
+def test_all_failed_status():
+    bad = FakeService("bad", always_fail=True)
+    t = make_transport(bad)
+    plan = Plan(nodes=[DagNode(name="bad", endpoint="local://bad", retries=0)])
+    res = run(orch(t).execute(plan, {}))
+    assert res.status == "failed"
+    assert res.results == {}
+
+
+def test_registry_resolves_endpoint_and_fallbacks():
+    from mcpx.registry import InMemoryRegistry, ServiceRecord
+
+    svc = FakeService("svc", always_fail=True)
+    fb = FakeService("svc-fb", result={"via": "fallback"})
+    t = make_transport(svc, fb)
+
+    async def go():
+        reg = InMemoryRegistry()
+        await reg.put(
+            ServiceRecord(
+                name="svc", endpoint="local://svc", fallbacks=["local://svc-fb"]
+            )
+        )
+        plan = Plan(nodes=[DagNode(name="svc", retries=0)])  # no endpoint in plan
+        return await orch(t, registry=reg).execute(plan, {})
+
+    res = run(go())
+    assert res.status == "ok"
+    assert res.results["svc"] == {"via": "fallback"}
+
+
+def test_timeout_is_an_error():
+    slow = FakeService("slow")
+    t = make_transport(slow, latencies={"slow": 0.2})
+    plan = Plan(nodes=[DagNode(name="slow", endpoint="local://slow", retries=0, timeout_s=0.05)])
+    res = run(orch(t).execute(plan, {}))
+    assert res.status == "failed"
+    assert res.trace.nodes["slow"].attempts[0].status == "timeout"
+
+
+def test_telemetry_recorded():
+    from mcpx.telemetry.stats import TelemetryStore
+
+    good = FakeService("good")
+    t = make_transport(good)
+    ts = TelemetryStore()
+    plan = Plan(nodes=[DagNode(name="good", endpoint="local://good")])
+    run(orch(t, telemetry=ts).execute(plan, {}))
+    assert ts.get("good").calls == 1

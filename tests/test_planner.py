@@ -1,0 +1,118 @@
+import asyncio
+
+import pytest
+
+from mcpx.core.config import PlannerConfig
+from mcpx.core.errors import PlannerError
+from mcpx.planner import HeuristicPlanner, MockPlanner, PlanContext
+from mcpx.registry import InMemoryRegistry, ServiceRecord
+from mcpx.telemetry.stats import TelemetryStore
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def registry_with(*records):
+    reg = InMemoryRegistry()
+    for r in records:
+        await reg.put(r)
+    return reg
+
+
+def svc(name, ins, outs, desc="", **kw):
+    return ServiceRecord(
+        name=name,
+        endpoint=f"local://{name}",
+        description=desc or name,
+        input_schema={k: "str" for k in ins},
+        output_schema={k: "str" for k in outs},
+        **kw,
+    )
+
+
+def test_mock_planner_canned_and_unknown():
+    from mcpx.core.dag import linear_plan
+
+    p = linear_plan(["a"])
+
+    async def go():
+        reg = await registry_with()
+        mp = MockPlanner(by_intent={"known": p})
+        ctx = PlanContext(registry=reg)
+        got = await mp.plan("known", ctx)
+        assert [n.name for n in got.nodes] == ["a"]
+        with pytest.raises(PlannerError):
+            await mp.plan("unknown", ctx)
+
+    run(go())
+
+
+def test_heuristic_chains_by_schema():
+    async def go():
+        reg = await registry_with(
+            svc("search", ["query"], ["document"], "search the web for documents"),
+            svc("summarize", ["document"], ["summary"], "summarize a document"),
+            svc("unrelated", ["zzz"], ["qqq"], "completely different billing thing"),
+        )
+        planner = HeuristicPlanner(PlannerConfig(shortlist_top_k=2))
+        plan = await planner.plan("search for a document and summarize it", PlanContext(registry=reg))
+        names = [n.name for n in plan.nodes]
+        assert "search" in names and "summarize" in names
+        assert "unrelated" not in names
+        # summarize consumes search's document output.
+        assert plan.node("summarize").inputs["document"] == "search"
+        assert plan.topological_generations() == [["search"], ["summarize"]]
+        assert plan.explanation  # README.md:50 made real
+        # Endpoints resolved from the registry, not invented.
+        assert plan.node("search").endpoint == "local://search"
+
+    run(go())
+
+
+def test_heuristic_penalises_failing_service():
+    async def go():
+        reg = await registry_with(
+            svc("rank-a", ["query"], ["score"], "rank results by query score"),
+            svc("rank-b", ["query"], ["score"], "rank results by query score"),
+        )
+        ts = TelemetryStore(alpha=0.5)
+        for _ in range(10):
+            ts.record("rank-a", latency_ms=10, ok=False)
+            ts.record("rank-b", latency_ms=10, ok=True)
+        planner = HeuristicPlanner(PlannerConfig(shortlist_top_k=1))
+        plan = await planner.plan(
+            "rank results by query score",
+            PlanContext(registry=reg, telemetry=ts.snapshot()),
+        )
+        assert [n.name for n in plan.nodes] == ["rank-b"]
+
+    run(go())
+
+
+def test_heuristic_respects_exclude_and_shortlist():
+    async def go():
+        reg = await registry_with(
+            svc("a", ["query"], ["x"], "query handler alpha"),
+            svc("b", ["query"], ["x"], "query handler beta"),
+        )
+        planner = HeuristicPlanner(PlannerConfig(shortlist_top_k=1))
+        plan = await planner.plan(
+            "query handler", PlanContext(registry=reg, exclude={"a"})
+        )
+        assert [n.name for n in plan.nodes] == ["b"]
+        plan = await planner.plan(
+            "query handler", PlanContext(registry=reg, shortlist=["a"])
+        )
+        assert [n.name for n in plan.nodes] == ["a"]
+
+    run(go())
+
+
+def test_heuristic_empty_registry_raises():
+    async def go():
+        reg = await registry_with()
+        with pytest.raises(PlannerError, match="empty"):
+            await HeuristicPlanner().plan("anything", PlanContext(registry=reg))
+
+    run(go())

@@ -1,0 +1,74 @@
+import asyncio
+import json
+
+import pytest
+
+from mcpx.core.errors import RegistryError
+from mcpx.registry import FileRegistry, InMemoryRegistry, ServiceRecord
+
+
+def rec(name="svc", endpoint="local://svc", **kw):
+    return ServiceRecord(name=name, endpoint=endpoint, **kw)
+
+
+def test_record_requires_name_and_endpoint():
+    with pytest.raises(RegistryError):
+        ServiceRecord(name="", endpoint="x")
+    with pytest.raises(RegistryError):
+        ServiceRecord(name="x", endpoint="")
+
+
+def test_record_from_dict_reference_shape():
+    # Reference record schema (README.md:86-95) with scalar `fallback`.
+    r = ServiceRecord.from_dict(
+        {
+            "name": "summarizer",
+            "endpoint": "http://s/sum",
+            "input_schema": {"text": "str"},
+            "output_schema": {"summary": "str"},
+            "cost_profile": {"latency_ms": 30, "cost": 1},
+            "fallback": "http://backup/sum",
+        }
+    )
+    assert r.fallbacks == ["http://backup/sum"]
+    assert r.cost_profile["latency_ms"] == 30.0
+    assert "summarizer" in r.schema_text()
+
+
+def test_memory_crud_and_versioning():
+    async def run():
+        reg = InMemoryRegistry()
+        assert await reg.version() == 0
+        await reg.put(rec("a"))
+        await reg.put(rec("b"))
+        assert await reg.version() == 2
+        assert (await reg.get("a")).name == "a"
+        assert [r.name for r in await reg.list_services()] == ["a", "b"]
+        assert await reg.delete("a") is True
+        assert await reg.delete("a") is False
+        assert await reg.version() == 3
+        assert await reg.get("a") is None
+
+    asyncio.run(run())
+
+
+def test_file_registry_roundtrip(tmp_path):
+    path = tmp_path / "reg.json"
+    path.write_text(json.dumps([rec("a").to_dict(), rec("b").to_dict()]))
+
+    async def run():
+        reg = FileRegistry(str(path))
+        assert [r.name for r in await reg.list_services()] == ["a", "b"]
+        await reg.put(rec("c"))
+        reg2 = FileRegistry(str(path))
+        assert [r.name for r in await reg2.list_services()] == ["a", "b", "c"]
+
+    asyncio.run(run())
+
+
+def test_file_registry_missing_file():
+    async def run():
+        with pytest.raises(RegistryError, match="not found"):
+            await FileRegistry("/nonexistent/reg.json").list_services()
+
+    asyncio.run(run())

@@ -1,0 +1,94 @@
+"""Telemetry-adaptive replanning (baseline config 4, SURVEY.md §5)."""
+
+import asyncio
+
+from mcpx.core.config import MCPXConfig
+from mcpx.orchestrator.transport import RouterTransport
+from mcpx.registry import ServiceRecord
+from mcpx.server.factory import build_control_plane
+
+from tests.helpers import FakeService, make_transport
+
+
+def svc_record(name, desc, ins, outs):
+    return ServiceRecord(
+        name=name,
+        endpoint=f"local://{name}",
+        description=desc,
+        input_schema={k: "str" for k in ins},
+        output_schema={k: "str" for k in outs},
+    )
+
+
+def test_plan_and_execute_replans_around_failure():
+    # Two interchangeable services; the first (lexically preferred) is down.
+    broken = FakeService("rank-broken", always_fail=True)
+    healthy = FakeService("rank-healthy", result={"score": "0.9"})
+
+    async def go():
+        cfg = MCPXConfig.from_dict(
+            {
+                "planner": {"kind": "heuristic", "shortlist_top_k": 1},
+                "orchestrator": {"retry_backoff_s": 0.0, "default_retries": 0},
+                "telemetry": {"max_replans": 2},
+            }
+        )
+        transport = RouterTransport(local=make_transport(broken, healthy))
+        cp = build_control_plane(cfg, transport=transport)
+        # 'aardvark' sorts rank-broken first on score ties -> deterministic
+        # first choice; both match the intent tokens equally.
+        await cp.registry.put(
+            svc_record("rank-broken", "rank items by score quality", ["query"], ["score"])
+        )
+        await cp.registry.put(
+            svc_record("rank-healthy", "rank items by score quality", ["query"], ["score"])
+        )
+        out = await cp.plan_and_execute("rank items by score quality", {"query": "q"})
+        assert out["status"] == "ok"
+        assert out["replans"] == 1
+        assert [n["name"] for n in out["graph"]["nodes"]] == ["rank-healthy"]
+        assert broken.calls and healthy.calls
+
+    asyncio.run(go())
+
+
+def test_replan_gives_up_after_budget():
+    b1 = FakeService("only-broken", always_fail=True)
+
+    async def go():
+        cfg = MCPXConfig.from_dict(
+            {
+                "planner": {"kind": "heuristic", "shortlist_top_k": 1},
+                "orchestrator": {"retry_backoff_s": 0.0},
+                "telemetry": {"max_replans": 2},
+            }
+        )
+        transport = RouterTransport(local=make_transport(b1))
+        cp = build_control_plane(cfg, transport=transport)
+        await cp.registry.put(
+            svc_record("only-broken", "solitary broken thing", ["query"], ["x"])
+        )
+        out = await cp.plan_and_execute("solitary broken thing", {"query": "q"})
+        assert out["status"] == "failed"
+        # One replan attempted, then the planner had nothing left (excluded)
+        # and the loop stopped with the last result.
+        assert out["replans"] <= 2
+
+    asyncio.run(go())
+
+
+def test_plan_cache_hits():
+    async def go():
+        cfg = MCPXConfig.from_dict({"planner": {"kind": "heuristic"}})
+        transport = RouterTransport(local=make_transport())
+        cp = build_control_plane(cfg, transport=transport)
+        await cp.registry.put(svc_record("alpha", "alpha thing", ["query"], ["x"]))
+        p1, _ = await cp.plan("alpha thing")
+        p2, _ = await cp.plan("alpha thing")
+        assert p1 is p2  # cache hit
+        # Registry mutation invalidates via version key.
+        await cp.registry.put(svc_record("beta", "beta thing", ["query"], ["y"]))
+        p3, _ = await cp.plan("alpha thing")
+        assert p3 is not p1
+
+    asyncio.run(go())

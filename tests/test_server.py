@@ -1,0 +1,165 @@
+"""API-surface integration tests: aiohttp TestClient against the full app
+with fake in-process microservices (SURVEY.md §4.4)."""
+
+import asyncio
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from mcpx.core.config import MCPXConfig
+from mcpx.orchestrator.transport import RouterTransport
+from mcpx.server.app import build_app
+from mcpx.server.factory import build_control_plane
+
+from tests.helpers import FakeService, make_transport
+
+
+def make_app(*services: FakeService, config=None, planner=None):
+    transport = RouterTransport(local=make_transport(*services))
+    cp = build_control_plane(config or MCPXConfig(), transport=transport, planner=planner)
+    return cp, build_app(cp)
+
+
+async def with_client(app, fn):
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        return await fn(client)
+    finally:
+        await client.close()
+
+
+def seed_services(cp, *records):
+    async def go():
+        for r in records:
+            await cp.registry.put(r)
+
+    return go()
+
+
+def test_full_flow_plan_execute():
+    from mcpx.registry import ServiceRecord
+
+    search = FakeService("search", result={"document": "the doc"})
+    summarize = FakeService("summarize", result={"summary": "short"})
+
+    async def go():
+        cp, app = make_app(search, summarize)
+        await cp.registry.put(
+            ServiceRecord(
+                name="search",
+                endpoint="local://search",
+                description="search documents by query",
+                input_schema={"query": "str"},
+                output_schema={"document": "str"},
+            )
+        )
+        await cp.registry.put(
+            ServiceRecord(
+                name="summarize",
+                endpoint="local://summarize",
+                description="summarize a document",
+                input_schema={"document": "str"},
+                output_schema={"summary": "str"},
+            )
+        )
+
+        async def drive(client):
+            # /plan (reference wire: PlanRequest{intent} -> PlanResponse{graph})
+            r = await client.post("/plan", json={"intent": "search documents and summarize"})
+            assert r.status == 200
+            plan_body = await r.json()
+            assert "graph" in plan_body and plan_body["explanation"]
+            # /execute with the planned graph
+            r = await client.post(
+                "/execute", json={"graph": plan_body["graph"], "payload": {"query": "q"}}
+            )
+            assert r.status == 200
+            body = await r.json()
+            assert body["status"] == "ok"
+            assert body["results"]["summarize"] == {"summary": "short"}
+            assert body["trace"]["nodes"]
+            # /plan_and_execute end to end
+            r = await client.post(
+                "/plan_and_execute",
+                json={"intent": "search documents and summarize", "payload": {"query": "q"}},
+            )
+            assert r.status == 200
+            body = await r.json()
+            assert body["status"] == "ok"
+            assert body["replans"] == 0
+
+        await with_client(app, drive)
+
+    asyncio.run(go())
+
+
+def test_validation_errors():
+    async def go():
+        cp, app = make_app()
+
+        async def drive(client):
+            r = await client.post("/plan", json={"intent": ""})
+            assert r.status == 400
+            r = await client.post("/plan", data=b"{not json")
+            assert r.status == 400
+            r = await client.post("/execute", json={"graph": {"nodes": [{"name": "a"}], "edges": [{"from": "a", "to": "ghost"}]}})
+            assert r.status == 422
+            body = await r.json()
+            assert any("ghost" in p for p in body["problems"])
+            # Empty registry -> planning fails cleanly.
+            r = await client.post("/plan", json={"intent": "do something"})
+            assert r.status == 422
+
+        await with_client(app, drive)
+
+    asyncio.run(go())
+
+
+def test_service_crud_and_observability():
+    async def go():
+        cp, app = make_app()
+
+        async def drive(client):
+            record = {
+                "name": "svc-a",
+                "endpoint": "local://svc-a",
+                "input_schema": {"x": "str"},
+                "output_schema": {"y": "str"},
+            }
+            r = await client.post("/services", json=record)
+            assert r.status == 201
+            r = await client.get("/services")
+            body = await r.json()
+            assert [s["name"] for s in body["services"]] == ["svc-a"]
+            assert body["version"] == 1
+            r = await client.get("/services/svc-a")
+            assert (await r.json())["endpoint"] == "local://svc-a"
+            r = await client.delete("/services/svc-a")
+            assert r.status == 200
+            r = await client.get("/services/svc-a")
+            assert r.status == 404
+            # Observability endpoints.
+            r = await client.get("/healthz")
+            assert (await r.json())["status"] == "ok"
+            r = await client.get("/metrics")
+            text = await r.text()
+            assert "mcpx_requests_total" in text
+            r = await client.get("/telemetry")
+            assert r.status == 200
+
+        await with_client(app, drive)
+
+    asyncio.run(go())
+
+
+def test_missing_registration_returns_400():
+    async def go():
+        cp, app = make_app()
+
+        async def drive(client):
+            r = await client.post("/services", json={"name": "x"})  # no endpoint
+            assert r.status == 400
+
+        await with_client(app, drive)
+
+    asyncio.run(go())

@@ -1,0 +1,82 @@
+"""Admission control (429), request timeout (504), trace-ID propagation."""
+
+import asyncio
+
+from mcpx.core.config import MCPXConfig
+from mcpx.core.dag import linear_plan
+from mcpx.orchestrator.transport import RouterTransport
+from mcpx.planner.mock import MockPlanner
+from mcpx.server.app import build_app
+from mcpx.server.factory import build_control_plane
+
+from tests.helpers import FakeService, make_transport
+from tests.test_server import with_client
+
+
+def test_max_concurrency_429_and_trace_header():
+    slow = FakeService("slow", result={"v": 1})
+
+    async def go():
+        cfg = MCPXConfig.from_dict({"server": {"max_concurrency": 1}})
+        transport = RouterTransport(local=make_transport(slow, latencies={"slow": 0.3}))
+        plan = linear_plan(["slow"])
+        plan.nodes[0].endpoint = "local://slow"
+        cp = build_control_plane(cfg, transport=transport, planner=MockPlanner(plan=plan))
+
+        async def drive(client):
+            graph = {"nodes": [{"name": "slow", "endpoint": "local://slow"}], "edges": []}
+            r1, r2 = await asyncio.gather(
+                client.post("/execute", json={"graph": graph}),
+                client.post("/execute", json={"graph": graph}),
+            )
+            statuses = sorted([r1.status, r2.status])
+            assert statuses == [200, 429], statuses
+            ok = r1 if r1.status == 200 else r2
+            assert ok.headers.get("X-Trace-Id")
+            # Non-limited endpoints stay available while saturated.
+            r = await client.get("/healthz")
+            assert r.status == 200
+
+        await with_client(build_app(cp), drive)
+
+    asyncio.run(go())
+
+
+def test_request_timeout_504():
+    slow = FakeService("slow", result={"v": 1})
+
+    async def go():
+        cfg = MCPXConfig.from_dict({"server": {"request_timeout_s": 0.05}})
+        transport = RouterTransport(local=make_transport(slow, latencies={"slow": 0.5}))
+        cp = build_control_plane(cfg, transport=transport)
+
+        async def drive(client):
+            graph = {
+                "nodes": [{"name": "slow", "endpoint": "local://slow", "timeout_s": 2.0}],
+                "edges": [],
+            }
+            r = await client.post("/execute", json={"graph": graph})
+            assert r.status == 504
+            body = await r.json()
+            assert "exceeded" in body["error"]
+
+        await with_client(build_app(cp), drive)
+
+    asyncio.run(go())
+
+
+def test_mock_planner_no_aliasing():
+    async def go():
+        plan = linear_plan(["a"])
+        mp = MockPlanner(plan=plan)
+        from mcpx.planner.base import PlanContext
+        from mcpx.registry import InMemoryRegistry
+
+        ctx = PlanContext(registry=InMemoryRegistry())
+        p1 = await mp.plan("intent-1", ctx)
+        p2 = await mp.plan("intent-2", ctx)
+        assert p1 is not p2 and p1 is not plan
+        assert p1.intent == "intent-1" and p2.intent == "intent-2"
+        assert plan.intent == ""  # template untouched
+
+    asyncio.run(go())

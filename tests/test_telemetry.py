@@ -1,0 +1,32 @@
+from mcpx.telemetry.metrics import Metrics
+from mcpx.telemetry.stats import TelemetryStore
+
+
+def test_ewma_converges():
+    t = TelemetryStore(alpha=0.5)
+    for _ in range(20):
+        t.record("svc", latency_ms=100.0, ok=True)
+    s = t.get("svc")
+    assert abs(s.ewma_latency_ms - 100.0) < 1e-6
+    assert s.ewma_error_rate == 0.0
+    assert s.calls == 20
+
+
+def test_error_rate_tracks_failures():
+    t = TelemetryStore(alpha=0.5)
+    t.record("svc", latency_ms=10, ok=True)
+    for _ in range(10):
+        t.record("svc", latency_ms=10, ok=False)
+    s = t.get("svc")
+    assert s.ewma_error_rate > 0.9
+    assert s.errors == 10
+
+
+def test_metrics_render_isolated_registries():
+    m1, m2 = Metrics(), Metrics()
+    m1.plans.labels(planner="Mock", status="ok").inc()
+    text = m1.render().decode()
+    assert "mcpx_plans_total" in text
+    assert 'planner="Mock"' in text
+    # Second instance has its own registry: no cross-talk.
+    assert 'planner="Mock"' not in m2.render().decode()
