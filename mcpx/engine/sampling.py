@@ -1,0 +1,43 @@
+"""Token sampling — jit-safe, mask-aware.
+
+The grammar-constrained planner (``mcpx.planner.grammar``) supplies a boolean
+vocab mask per step; masking happens on the logits *before* temperature/top-k
+so constrained decoding composes with any sampling config. All branches are
+trace-free (``lax.cond``-style selects), so one compiled sampler serves
+greedy and stochastic decoding.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def sample(
+    logits: jax.Array,
+    key: jax.Array,
+    *,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Sample token ids from [B, V] logits.
+
+    ``temperature<=0`` is greedy argmax. ``top_k>0`` restricts sampling to the
+    k highest logits. ``mask`` is a [B, V] or [V] boolean array — False
+    entries are excluded (grammar-constrained decoding).
+    """
+    logits = logits.astype(jnp.float32)
+    if mask is not None:
+        logits = jnp.where(mask, logits, NEG_INF)
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / jnp.asarray(temperature, jnp.float32)
+    if top_k > 0 and top_k < logits.shape[-1]:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, NEG_INF, logits)
+    return jax.random.categorical(key, logits, axis=-1)

@@ -1,0 +1,227 @@
+"""Gemma-architecture decoder — pure-functional JAX, TPU-first.
+
+Design choices (vs a torch-style port):
+  - params are a plain pytree with layer weights **stacked on a leading
+    axis**, and the layer stack runs under ``lax.scan`` — one layer is traced
+    and compiled once regardless of depth, and XLA pipelines the scan;
+  - two entry points, both jit-friendly with **static shapes**: ``prefill``
+    (full-sequence, causal) and ``decode_step`` (one token per sequence
+    against a KV cache) — no data-dependent Python control flow;
+  - attention logits/softmax computed in float32, weights stored bfloat16
+    (MXU-native);
+  - GQA/MQA: queries reshaped to [B, T, K, q_per_kv, hd] so the same einsum
+    serves MHA (K=H), GQA and MQA (K=1) without branching;
+  - KV cache is a dense [L, B, S, K, hd] pytree here; the paged-attention
+    engine (``mcpx.engine``) swaps in Pallas kernels for the decode hot loop.
+
+The reference framework has no model code (its planner is a remote OpenAI
+call, reference ``control_plane.py:69-73``); this module is the north star's
+in-tree replacement.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from mcpx.models.gemma.config import GemmaConfig
+
+Params = dict[str, Any]
+KVCache = dict[str, jax.Array]
+
+
+# --------------------------------------------------------------------- init
+def init_params(cfg: GemmaConfig, key: jax.Array) -> Params:
+    """Random-init parameters (bfloat16 by default), layer-stacked."""
+    dtype = jnp.dtype(cfg.dtype)
+    k_embed, k_q, k_k, k_v, k_o, k_gate, k_up, k_down = jax.random.split(key, 8)
+    L, D, H, K, hd, F, V = (
+        cfg.n_layers,
+        cfg.d_model,
+        cfg.n_heads,
+        cfg.n_kv_heads,
+        cfg.head_dim,
+        cfg.d_ff,
+        cfg.vocab_size,
+    )
+
+    def normal(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) / math.sqrt(fan_in)).astype(dtype)
+
+    return {
+        "embed": normal(k_embed, (V, D), D),
+        "layers": {
+            "pre_attn_norm": jnp.zeros((L, D), dtype),
+            "pre_mlp_norm": jnp.zeros((L, D), dtype),
+            "wq": normal(k_q, (L, D, H, hd), D),
+            "wk": normal(k_k, (L, D, K, hd), D),
+            "wv": normal(k_v, (L, D, K, hd), D),
+            "wo": normal(k_o, (L, H, hd, D), H * hd),
+            "w_gate": normal(k_gate, (L, D, F), D),
+            "w_up": normal(k_up, (L, D, F), D),
+            "w_down": normal(k_down, (L, F, D), F),
+        },
+        "final_norm": jnp.zeros((D,), dtype),
+    }
+
+
+def init_kv_cache(cfg: GemmaConfig, batch: int, max_len: int, dtype: str | None = None) -> KVCache:
+    d = jnp.dtype(dtype or cfg.dtype)
+    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, d), "v": jnp.zeros(shape, d)}
+
+
+# ------------------------------------------------------------------- pieces
+def rms_norm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    normed = x32 * lax.rsqrt(var + eps)
+    # Gemma convention: scale is a residual around 1.
+    return (normed * (1.0 + scale.astype(jnp.float32))).astype(x.dtype)
+
+
+def apply_rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embeddings. x: [..., seq, heads, head_dim]; positions: [..., seq]."""
+    head_dim = x.shape[-1]
+    half = head_dim // 2
+    freq = jnp.exp(
+        -math.log(theta) * (2.0 * jnp.arange(half, dtype=jnp.float32) / head_dim)
+    )  # [half]
+    angles = positions[..., None].astype(jnp.float32) * freq  # [..., seq, half]
+    cos = jnp.cos(angles)[..., None, :]  # broadcast over heads
+    sin = jnp.sin(angles)[..., None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    x32_1, x32_2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    out = jnp.concatenate(
+        [x32_1 * cos - x32_2 * sin, x32_2 * cos + x32_1 * sin], axis=-1
+    )
+    return out.astype(x.dtype)
+
+
+def _attend(q: jax.Array, k: jax.Array, v: jax.Array, mask: jax.Array) -> jax.Array:
+    """q: [B, T, K, G, hd]; k,v: [B, S, K, hd]; mask: [B, T, S] (True=keep).
+
+    Returns [B, T, K, G, hd]. Softmax in float32.
+    """
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("btkgh,bskh->btkgs", q, k, preferred_element_type=jnp.float32)
+    logits = logits * scale
+    logits = jnp.where(mask[:, :, None, None, :], logits, -1e30)
+    weights = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("btkgs,bskh->btkgh", weights.astype(v.dtype), v)
+    return out
+
+
+def _layer(
+    x: jax.Array,
+    lp: dict[str, jax.Array],
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    positions: jax.Array,
+    mask: jax.Array,
+    write_idx: jax.Array,
+    cfg: GemmaConfig,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One transformer block over [B, T]; writes K/V at ``write_idx``.
+
+    x: [B, T, D]; k_cache/v_cache: [B, S, K, hd]; positions: [B, T];
+    mask: [B, T, S]; write_idx: [B, T] absolute cache slots for this chunk.
+    """
+    B, T, D = x.shape
+    h = rms_norm(x, lp["pre_attn_norm"], cfg.norm_eps)
+    q = jnp.einsum("btd,dkh->btkh", h, lp["wq"])
+    k = jnp.einsum("btd,dkh->btkh", h, lp["wk"])
+    v = jnp.einsum("btd,dkh->btkh", h, lp["wv"])
+    q = apply_rope(q, positions, cfg.rope_theta)
+    k = apply_rope(k, positions, cfg.rope_theta)
+
+    b_idx = jnp.arange(B)[:, None]  # [B, 1] broadcast with write_idx [B, T]
+    k_cache = k_cache.at[b_idx, write_idx].set(k.astype(k_cache.dtype))
+    v_cache = v_cache.at[b_idx, write_idx].set(v.astype(v_cache.dtype))
+
+    qg = q.reshape(B, T, cfg.n_kv_heads, cfg.q_per_kv, cfg.head_dim)
+    attn = _attend(qg, k_cache, v_cache, mask)
+    attn = attn.reshape(B, T, cfg.n_heads * cfg.head_dim)
+    wo = lp["wo"].reshape(cfg.n_heads * cfg.head_dim, D)
+    x = x + jnp.einsum("btf,fd->btd", attn, wo)
+
+    h = rms_norm(x, lp["pre_mlp_norm"], cfg.norm_eps)
+    gate = jnp.einsum("btd,df->btf", h, lp["w_gate"])
+    up = jnp.einsum("btd,df->btf", h, lp["w_up"])
+    ff = jax.nn.gelu(gate, approximate=True) * up
+    x = x + jnp.einsum("btf,fd->btd", ff, lp["w_down"])
+    return x, k_cache, v_cache
+
+
+def forward(
+    params: Params,
+    cfg: GemmaConfig,
+    tokens: jax.Array,
+    positions: jax.Array,
+    kv_cache: KVCache,
+    mask: jax.Array,
+) -> tuple[jax.Array, KVCache]:
+    """Core forward over a [B, T] token chunk against a [L, B, S, K, hd]
+    cache. ``positions`` are absolute (double as cache write slots);
+    ``mask`` is [B, T, S] (True = attend)."""
+    x = params["embed"][tokens].astype(jnp.dtype(cfg.dtype))
+    x = x * jnp.asarray(math.sqrt(cfg.d_model), x.dtype)
+
+    def body(carry, scanned):
+        x = carry
+        lp, k_c, v_c = scanned
+        x, k_c, v_c = _layer(x, lp, k_c, v_c, positions, mask, positions, cfg)
+        return x, (k_c, v_c)
+
+    x, (k_new, v_new) = lax.scan(
+        body, x, (params["layers"], kv_cache["k"], kv_cache["v"])
+    )
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum(
+        "btd,vd->btv", x, params["embed"], preferred_element_type=jnp.float32
+    )
+    return logits, {"k": k_new, "v": v_new}
+
+
+# -------------------------------------------------------------- entrypoints
+def prefill(
+    params: Params,
+    cfg: GemmaConfig,
+    tokens: jax.Array,
+    seq_lens: jax.Array,
+    kv_cache: KVCache,
+) -> tuple[jax.Array, KVCache]:
+    """Prefill a padded [B, T] batch. ``seq_lens`` [B] masks right-padding.
+
+    Returns logits [B, T, V] and the filled cache.
+    """
+    B, T = tokens.shape
+    S = kv_cache["k"].shape[2]
+    positions = jnp.broadcast_to(jnp.arange(T), (B, T))
+    s = jnp.arange(S)
+    causal = s[None, None, :] <= positions[:, :, None]  # [B, T, S]
+    valid = s[None, None, :] < seq_lens[:, None, None]
+    mask = causal & valid
+    return forward(params, cfg, tokens, positions, kv_cache, mask)
+
+
+def decode_step(
+    params: Params,
+    cfg: GemmaConfig,
+    token: jax.Array,
+    cur_index: jax.Array,
+    kv_cache: KVCache,
+) -> tuple[jax.Array, KVCache]:
+    """One decode step: ``token`` [B] is written at per-sequence slot
+    ``cur_index`` [B]; attends to cache[0..cur_index]. Returns logits [B, V]
+    and the updated cache."""
+    B = token.shape[0]
+    S = kv_cache["k"].shape[2]
+    positions = cur_index[:, None]  # [B, 1]
+    mask = jnp.arange(S)[None, None, :] <= positions[:, :, None]  # [B, 1, S]
+    logits, kv_cache = forward(params, cfg, token[:, None], positions, kv_cache, mask)
+    return logits[:, 0, :], kv_cache

@@ -1,0 +1,71 @@
+"""Checkpoint save/load (Orbax) with sharding-aware restore.
+
+The reference persists nothing anywhere (SURVEY.md §5 checkpoint/resume:
+"there are no writes at all"). Here model weights are Orbax checkpoints that
+restore *directly onto the mesh* — each host/device materialises only its
+shard, which is what makes 2B/7B loads fit HBM without a host-RAM spike.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import orbax.checkpoint as ocp
+from jax.sharding import Mesh, NamedSharding
+
+from mcpx.core.errors import EngineError
+from mcpx.models.gemma.config import GemmaConfig
+from mcpx.models.gemma.model import Params, init_params
+from mcpx.parallel.mesh import param_pspecs
+
+
+def save_checkpoint(path: str, params: Params) -> None:
+    path = os.path.abspath(path)
+    with ocp.PyTreeCheckpointer() as ckptr:
+        ckptr.save(path, params)
+
+
+def load_checkpoint(
+    path: str, cfg: GemmaConfig, mesh: Optional[Mesh] = None
+) -> Params:
+    """Restore params; when ``mesh`` is given, arrays are restored already
+    sharded per ``param_pspecs`` (no full-replica host copy)."""
+    path = os.path.abspath(path)
+    if not os.path.exists(path):
+        raise EngineError(f"checkpoint not found: {path}")
+    with ocp.PyTreeCheckpointer() as ckptr:
+        if mesh is None:
+            return ckptr.restore(path)
+        specs = param_pspecs(cfg, mesh)
+        abstract = jax.eval_shape(lambda: init_params(cfg, jax.random.PRNGKey(0)))
+        targets = jax.tree.map(
+            lambda a, spec: jax.ShapeDtypeStruct(
+                a.shape, a.dtype, sharding=NamedSharding(mesh, spec)
+            ),
+            abstract,
+            specs,
+        )
+        restore_args = ocp.checkpoint_utils.construct_restore_args(targets)
+        return ckptr.restore(
+            path, restore_args=restore_args
+        )
+
+
+def load_or_init(
+    cfg: GemmaConfig,
+    checkpoint_path: str = "",
+    mesh: Optional[Mesh] = None,
+    seed: int = 0,
+) -> tuple[Params, str]:
+    """Load a checkpoint if configured, else random-init (optionally onto the
+    mesh). Returns (params, source) where source is "checkpoint" | "random"."""
+    if checkpoint_path:
+        return load_checkpoint(checkpoint_path, cfg, mesh), "checkpoint"
+    params = init_params(cfg, jax.random.PRNGKey(seed))
+    if mesh is not None:
+        from mcpx.parallel.mesh import shard_pytree
+
+        params = shard_pytree(params, param_pspecs(cfg, mesh), mesh)
+    return params, "random"

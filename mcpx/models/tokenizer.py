@@ -1,0 +1,53 @@
+"""In-tree byte-level tokenizer.
+
+The reference outsources tokenisation to OpenAI (reference
+``control_plane.py:69-73``); this framework runs fully self-contained on the
+TPU VM (north star: "no external API in the loop"), so the default tokenizer
+ships in-tree with zero external files: UTF-8 bytes are token ids 0..255,
+plus special tokens. Byte-level tokens make grammar-constrained JSON decoding
+(``mcpx.planner.grammar``) exact — every JSON byte is one token, so the
+grammar automaton masks logits without any subword-boundary ambiguity.
+
+The vocab is padded to a multiple of 128 (MXU lane width) so the embedding
+and logit matmuls tile cleanly on the TPU systolic array.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+PAD_ID = 256
+BOS_ID = 257
+EOS_ID = 258
+_N_SPECIAL = 3
+_MXU_PAD = 128
+
+
+class ByteTokenizer:
+    """UTF-8 byte tokenizer: ids 0..255 are bytes, then PAD/BOS/EOS."""
+
+    pad_id = PAD_ID
+    bos_id = BOS_ID
+    eos_id = EOS_ID
+
+    def __init__(self) -> None:
+        raw = 256 + _N_SPECIAL
+        self.vocab_size = ((raw + _MXU_PAD - 1) // _MXU_PAD) * _MXU_PAD  # 384
+
+    def encode(self, text: str, *, bos: bool = True, eos: bool = False) -> list[int]:
+        ids = list(text.encode("utf-8"))
+        if bos:
+            ids = [BOS_ID] + ids
+        if eos:
+            ids = ids + [EOS_ID]
+        return ids
+
+    def decode(self, ids: Iterable[int]) -> str:
+        data = bytes(i for i in ids if 0 <= i < 256)
+        return data.decode("utf-8", errors="replace")
+
+    def byte_id(self, char: str) -> int:
+        b = char.encode("utf-8")
+        if len(b) != 1:
+            raise ValueError(f"{char!r} is not a single byte")
+        return b[0]

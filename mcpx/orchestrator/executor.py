@@ -81,13 +81,18 @@ class Orchestrator:
         results: dict[str, Any] = {}
         errors: dict[str, str] = {}
         failed: set[str] = set()  # failed or skipped node names
+        # O(N+E) indices once, not O(N*(N+E)) scans in the scheduling loop.
+        by_name = {n.name: n for n in plan.nodes}
+        preds: dict[str, list[str]] = {n.name: [] for n in plan.nodes}
+        for e in plan.edges:
+            preds[e.dst].append(e.src)
 
         with trace.span("execute"):
             for generation in plan.topological_generations():
                 runnable: list[DagNode] = []
                 for name in generation:
-                    node = plan.node(name)
-                    bad_preds = [p for p in plan.predecessors(name) if p in failed]
+                    node = by_name[name]
+                    bad_preds = [p for p in preds[name] if p in failed]
                     if bad_preds:
                         failed.add(name)
                         errors[name] = f"skipped: upstream failed ({', '.join(sorted(bad_preds))})"
@@ -124,7 +129,27 @@ class Orchestrator:
         payload: dict[str, Any],
         trace: ExecutionTrace,
     ) -> tuple[bool, Any]:
-        """Returns ``(True, response)`` or ``(False, final_error_message)``."""
+        """Returns ``(True, response)`` or ``(False, final_error_message)``.
+
+        Never raises: any unexpected exception (registry backend down,
+        malformed record) becomes a node failure so sibling nodes keep
+        running and the partial-results contract holds.
+        """
+        try:
+            return await self._run_node_inner(node, results, payload, trace)
+        except Exception as e:  # noqa: BLE001 - isolation boundary per node
+            nt = trace.node(node.name, node.service)
+            nt.status = "failed"
+            nt.finished_at = asyncio.get_event_loop().time()
+            return False, f"internal error running node '{node.name}': {e}"
+
+    async def _run_node_inner(
+        self,
+        node: DagNode,
+        results: dict[str, Any],
+        payload: dict[str, Any],
+        trace: ExecutionTrace,
+    ) -> tuple[bool, Any]:
         nt = trace.node(node.name, node.service)
         nt.started_at = asyncio.get_event_loop().time()
 

@@ -1,0 +1,17 @@
+from mcpx.parallel.mesh import (
+    make_mesh,
+    param_pspecs,
+    kv_cache_pspecs,
+    shard_pytree,
+    data_pspec,
+    replicated,
+)
+
+__all__ = [
+    "make_mesh",
+    "param_pspecs",
+    "kv_cache_pspecs",
+    "shard_pytree",
+    "data_pspec",
+    "replicated",
+]

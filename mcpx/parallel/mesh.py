@@ -1,0 +1,94 @@
+"""Device mesh + sharding layout for the inference engine.
+
+The distributed backend is XLA collectives over ICI, driven entirely by
+sharding annotations on a named ``Mesh(("data", "model"))`` — no hand-written
+transport (SURVEY.md §2.3: the reference has no distributed backend at all;
+ours is GSPMD). Axis layout for a v5e-8:
+
+  - ``model`` (TP): attention heads and the MLP hidden dim are sharded;
+    activations all-reduce (psum) after ``wo`` and ``w_down`` — XLA inserts
+    these from the annotations. The embedding is sharded on vocab, so logits
+    materialise vocab-sharded and the sampler's argmax/top-k runs sharded.
+  - ``data`` (DP): the request batch splits across replicas; KV caches are
+    sharded on batch over ``data`` and on KV heads over ``model`` when the
+    head count divides (MQA keeps KV replicated on ``model`` — the standard
+    MQA-TP layout).
+
+Divisibility-aware: any weight axis that doesn't divide the mesh axis is
+replicated rather than erroring, so the same code serves 1-chip CI, the
+8-device virtual CPU mesh, and a v5e-8.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from mcpx.core.errors import ConfigError
+from mcpx.models.gemma.config import GemmaConfig
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(
+    data: int = 1, model: int = 1, devices: Optional[Sequence[jax.Device]] = None
+) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    if data * model > len(devices):
+        raise ConfigError(
+            f"mesh {data}x{model} needs {data * model} devices, have {len(devices)}"
+        )
+    grid = np.asarray(devices[: data * model]).reshape(data, model)
+    return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+
+
+def _axis(mesh: Mesh, axis: str, dim: int) -> Optional[str]:
+    """Shard ``dim`` over ``axis`` only when it divides evenly."""
+    size = mesh.shape[axis]
+    return axis if size > 1 and dim % size == 0 else None
+
+
+def param_pspecs(cfg: GemmaConfig, mesh: Mesh) -> dict[str, Any]:
+    """PartitionSpec pytree matching ``init_params`` output."""
+    m = lambda dim: _axis(mesh, MODEL_AXIS, dim)
+    return {
+        "embed": P(m(cfg.vocab_size), None),
+        "layers": {
+            "pre_attn_norm": P(None, None),
+            "pre_mlp_norm": P(None, None),
+            "wq": P(None, None, m(cfg.n_heads), None),
+            "wk": P(None, None, m(cfg.n_kv_heads), None),
+            "wv": P(None, None, m(cfg.n_kv_heads), None),
+            "wo": P(None, m(cfg.n_heads), None, None),
+            "w_gate": P(None, None, m(cfg.d_ff)),
+            "w_up": P(None, None, m(cfg.d_ff)),
+            "w_down": P(None, m(cfg.d_ff), None),
+        },
+        "final_norm": P(None),
+    }
+
+
+def kv_cache_pspecs(cfg: GemmaConfig, mesh: Mesh, batch: int) -> dict[str, Any]:
+    b = _axis(mesh, DATA_AXIS, batch)
+    k = _axis(mesh, MODEL_AXIS, cfg.n_kv_heads)
+    spec = P(None, b, None, k, None)  # [L, B, S, K, hd]
+    return {"k": spec, "v": spec}
+
+
+def data_pspec(mesh: Mesh, batch: int) -> P:
+    return P(_axis(mesh, DATA_AXIS, batch))
+
+
+def replicated(mesh: Mesh) -> P:
+    return P()
+
+
+def shard_pytree(tree: Any, specs: Any, mesh: Mesh) -> Any:
+    """Place a pytree on the mesh according to a spec pytree."""
+    return jax.tree.map(
+        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)), tree, specs
+    )

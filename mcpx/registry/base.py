@@ -45,16 +45,23 @@ class ServiceRecord:
         fb = obj.get("fallbacks", obj.get("fallback", []))
         if isinstance(fb, str):
             fb = [fb] if fb else []
-        return cls(
-            name=str(obj.get("name", "")),
-            endpoint=str(obj.get("endpoint", "")),
-            description=str(obj.get("description", "") or ""),
-            input_schema=dict(obj.get("input_schema", {}) or {}),
-            output_schema=dict(obj.get("output_schema", {}) or {}),
-            cost_profile={k: float(v) for k, v in (obj.get("cost_profile", {}) or {}).items()},
-            fallbacks=list(fb or []),
-            tags=list(obj.get("tags", []) or []),
-        )
+        try:
+            return cls(
+                name=str(obj.get("name", "")),
+                endpoint=str(obj.get("endpoint", "")),
+                description=str(obj.get("description", "") or ""),
+                input_schema=dict(obj.get("input_schema", {}) or {}),
+                output_schema=dict(obj.get("output_schema", {}) or {}),
+                cost_profile={
+                    k: float(v) for k, v in (obj.get("cost_profile", {}) or {}).items()
+                },
+                fallbacks=list(fb or []),
+                tags=list(obj.get("tags", []) or []),
+            )
+        except (TypeError, ValueError) as e:
+            raise RegistryError(
+                f"malformed service record {obj.get('name', '?')!r}: {e}"
+            ) from e
 
     def to_dict(self) -> dict[str, Any]:
         return {

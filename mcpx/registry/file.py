@@ -21,6 +21,7 @@ class FileRegistry(RegistryBackend):
         self._path = path
         self._mem = InMemoryRegistry()
         self._loaded = False
+        self._flush_lock = asyncio.Lock()
 
     async def _ensure_loaded(self) -> None:
         if self._loaded:
@@ -63,13 +64,16 @@ class FileRegistry(RegistryBackend):
         return await self._mem.version()
 
     async def _flush(self) -> None:
-        records = [r.to_dict() for r in await self._mem.list_services()]
+        # Serialised: concurrent put/delete must not interleave temp-file
+        # writes (atomic replace from a unique temp name, one at a time).
+        async with self._flush_lock:
+            records = [r.to_dict() for r in await self._mem.list_services()]
 
-        def write() -> None:
-            tmp = self._path + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump(records, f, indent=2)
-            os.replace(tmp, self._path)
+            def write() -> None:
+                tmp = f"{self._path}.{os.getpid()}.{id(self)}.tmp"
+                with open(tmp, "w") as f:
+                    json.dump(records, f, indent=2)
+                os.replace(tmp, self._path)
 
-        # Off the event loop: a large registry write must not stall requests.
-        await asyncio.to_thread(write)
+            # Off the event loop: a large write must not stall requests.
+            await asyncio.to_thread(write)

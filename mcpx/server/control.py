@@ -114,6 +114,10 @@ class ControlPlane:
             except Exception:
                 break  # nothing viable left to route around; keep last result
             result = await self.execute(plan, payload, trace)
+        if trace.replans and result.status == "ok" and self.config.planner.plan_cache_size > 0:
+            # The repaired plan is the one worth caching; otherwise every
+            # request for this intent repeats the fail->replan cycle.
+            self._plan_cache[(intent, await self.registry.version())] = plan
         return {
             "graph": plan.to_wire(),
             "results": result.results,

@@ -8,8 +8,23 @@ driver's bench run.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# Force CPU unconditionally: the session env points JAX at a live TPU
+# (platform "axon", registered by a sitecustomize that imports jax at
+# interpreter start, so env vars alone are latched too late). Unit tests
+# must be deterministic, fast, and use full-f32 matmuls (TPU defaults
+# matmul inputs to bf16), so override via jax.config after import.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = [
+    f
+    for f in os.environ.get("XLA_FLAGS", "").split()
+    if "xla_force_host_platform_device_count" not in f
+]
+_flags.append("--xla_force_host_platform_device_count=8")
+os.environ["XLA_FLAGS"] = " ".join(_flags)
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.default_backend() == "cpu", "tests must run on CPU"
+assert len(jax.devices()) == 8, "tests expect an 8-device virtual CPU mesh"

@@ -1,0 +1,45 @@
+"""Orbax checkpoint round-trip, including sharded restore onto a mesh."""
+
+import jax
+import numpy as np
+import pytest
+
+from mcpx.core.errors import EngineError
+from mcpx.models.gemma import GemmaConfig, init_params
+from mcpx.models.gemma.params import load_checkpoint, load_or_init, save_checkpoint
+from mcpx.parallel import make_mesh
+
+
+def test_roundtrip_and_sharded_restore(tmp_path):
+    cfg = GemmaConfig(dtype="float32")
+    params = init_params(cfg, jax.random.PRNGKey(7))
+    path = str(tmp_path / "ckpt")
+    save_checkpoint(path, params)
+
+    restored = load_checkpoint(path, cfg)
+    np.testing.assert_array_equal(
+        np.asarray(restored["embed"]), np.asarray(params["embed"])
+    )
+
+    mesh = make_mesh(data=2, model=4)
+    sharded = load_checkpoint(path, cfg, mesh)
+    from jax.sharding import PartitionSpec as P
+
+    assert sharded["layers"]["wq"].sharding.spec == P(None, None, "model", None)
+    np.testing.assert_array_equal(
+        np.asarray(sharded["layers"]["wq"]), np.asarray(params["layers"]["wq"])
+    )
+
+
+def test_load_or_init_random(tmp_path):
+    cfg = GemmaConfig(dtype="float32")
+    mesh = make_mesh(data=1, model=8)
+    params, source = load_or_init(cfg, "", mesh)
+    assert source == "random"
+    assert params["layers"]["w_gate"].sharding.mesh.shape["model"] == 8
+
+
+def test_missing_checkpoint_raises():
+    cfg = GemmaConfig()
+    with pytest.raises(EngineError, match="not found"):
+        load_checkpoint("/nonexistent/ckpt", cfg)

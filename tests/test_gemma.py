@@ -1,0 +1,117 @@
+"""Model correctness on CPU: prefill/decode agreement, padding invariance,
+sampling, tokenizer round-trips (SURVEY.md §4.1/§4.5)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mcpx.engine.sampling import sample
+from mcpx.models.gemma import (
+    GemmaConfig,
+    decode_step,
+    init_kv_cache,
+    init_params,
+    prefill,
+)
+from mcpx.models.tokenizer import ByteTokenizer
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    # float32 for tight numeric comparisons on CPU.
+    return GemmaConfig(dtype="float32", max_seq_len=64)
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    return init_params(cfg, jax.random.PRNGKey(0))
+
+
+def test_tokenizer_roundtrip():
+    tok = ByteTokenizer()
+    text = 'plan: {"nodes": [1, 2]} — ünïcode ✓'
+    ids = tok.encode(text, bos=True, eos=True)
+    assert ids[0] == tok.bos_id and ids[-1] == tok.eos_id
+    assert tok.decode(ids) == text
+    assert tok.vocab_size % 128 == 0
+
+
+def test_prefill_shapes(cfg, params):
+    B, T, S = 2, 8, 16
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (B, T), 0, 256)
+    cache = init_kv_cache(cfg, B, S)
+    logits, cache = prefill(params, cfg, tokens, jnp.array([T, T]), cache)
+    assert logits.shape == (B, T, cfg.vocab_size)
+    assert cache["k"].shape == (cfg.n_layers, B, S, cfg.n_kv_heads, cfg.head_dim)
+    assert not np.any(np.isnan(logits))
+
+
+def test_decode_matches_prefill(cfg, params):
+    """Token-by-token decode must reproduce full-sequence prefill logits."""
+    B, T, S = 1, 10, 16
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (B, T), 0, 256)
+
+    cache = init_kv_cache(cfg, B, S)
+    full_logits, _ = prefill(params, cfg, tokens, jnp.array([T]), cache)
+
+    # Prefill just the first token, then decode the rest one at a time.
+    cache = init_kv_cache(cfg, B, S)
+    step_logits, cache = prefill(params, cfg, tokens[:, :1], jnp.array([1]), cache)
+    got = [step_logits[:, 0]]
+    for t in range(1, T):
+        lg, cache = decode_step(params, cfg, tokens[:, t], jnp.array([t]), cache)
+        got.append(lg)
+    got = jnp.stack(got, axis=1)  # [B, T, V]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(full_logits), rtol=2e-4, atol=2e-4)
+
+
+def test_padding_invariance(cfg, params):
+    """Right-padding beyond seq_len must not change valid-position logits."""
+    B, T = 1, 6
+    tok = ByteTokenizer()
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (B, T), 0, 256)
+    padded = jnp.concatenate(
+        [tokens, jnp.full((B, 4), tok.pad_id, tokens.dtype)], axis=1
+    )
+    cache_a = init_kv_cache(cfg, B, 16)
+    cache_b = init_kv_cache(cfg, B, 16)
+    la, _ = prefill(params, cfg, tokens, jnp.array([T]), cache_a)
+    lb, _ = prefill(params, cfg, padded, jnp.array([T]), cache_b)
+    np.testing.assert_allclose(
+        np.asarray(la), np.asarray(lb[:, :T]), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_batch_order_invariance(cfg, params):
+    """Each batch row is independent (mask correctness across rows)."""
+    T = 5
+    t1 = jax.random.randint(jax.random.PRNGKey(4), (1, T), 0, 256)
+    t2 = jax.random.randint(jax.random.PRNGKey(5), (1, T), 0, 256)
+    both = jnp.concatenate([t1, t2], axis=0)
+    la, _ = prefill(params, cfg, both, jnp.array([T, T]), init_kv_cache(cfg, 2, 8))
+    lb, _ = prefill(params, cfg, t1, jnp.array([T]), init_kv_cache(cfg, 1, 8))
+    np.testing.assert_allclose(np.asarray(la[0]), np.asarray(lb[0]), rtol=1e-5, atol=1e-5)
+
+
+def test_sampling_modes():
+    key = jax.random.PRNGKey(0)
+    logits = jnp.array([[0.0, 5.0, 1.0, -2.0]])
+    # Greedy.
+    assert int(sample(logits, key)[0]) == 1
+    # Mask blocks the argmax.
+    mask = jnp.array([[True, False, True, True]])
+    assert int(sample(logits, key, mask=mask)[0]) == 2
+    # Temperature sampling stays within the mask.
+    for i in range(5):
+        t = sample(logits, jax.random.PRNGKey(i), temperature=1.0, top_k=2, mask=mask)
+        assert int(t[0]) in (0, 2, 3)
+
+
+def test_named_configs():
+    c2b = GemmaConfig.named("2b")
+    assert c2b.n_layers == 18 and c2b.n_kv_heads == 1
+    c7b = GemmaConfig.named("7b")
+    assert c7b.n_heads == c7b.n_kv_heads == 16
+    with pytest.raises(Exception):
+        GemmaConfig.named("70b")

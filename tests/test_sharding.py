@@ -1,0 +1,110 @@
+"""Distributed-without-a-cluster tests (SURVEY.md §4.3): 8 virtual CPU
+devices; sharded execution must match single-device execution bit-for-bit
+(same math, different layout)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from mcpx.models.gemma import (
+    GemmaConfig,
+    decode_step,
+    init_kv_cache,
+    init_params,
+    prefill,
+)
+from mcpx.parallel import (
+    data_pspec,
+    kv_cache_pspecs,
+    make_mesh,
+    param_pspecs,
+    shard_pytree,
+)
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    # d_ff=256 and n_heads=4 shard over model=4; batch 4 shards over data=2.
+    return GemmaConfig(dtype="float32", max_seq_len=32)
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    return init_params(cfg, jax.random.PRNGKey(0))
+
+
+def test_mesh_axes():
+    mesh = make_mesh(data=2, model=4)
+    assert mesh.shape == {"data": 2, "model": 4}
+
+
+def test_mesh_too_big_raises():
+    from mcpx.core.errors import ConfigError
+
+    with pytest.raises(ConfigError, match="needs 16 devices"):
+        make_mesh(data=4, model=4)
+
+
+def test_param_shardings_applied(cfg, params):
+    mesh = make_mesh(data=2, model=4)
+    specs = param_pspecs(cfg, mesh)
+    sharded = shard_pytree(params, specs, mesh)
+    # n_heads=4 over model=4: wq sharded on the head axis.
+    wq = sharded["layers"]["wq"]
+    assert wq.sharding.spec == P(None, None, "model", None)
+    # n_kv_heads=1 cannot shard over model=4: replicated.
+    assert sharded["layers"]["wk"].sharding.spec == P(None, None, None, None)
+    # MLP hidden dim sharded.
+    assert sharded["layers"]["w_gate"].sharding.spec == P(None, None, "model")
+
+
+def test_tp_dp_logits_match_single_device(cfg, params):
+    B, T, S = 4, 6, 8
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (B, T), 0, 256)
+    seq_lens = jnp.full((B,), T)
+
+    # Single device reference.
+    ref_logits, ref_cache = jax.jit(prefill, static_argnums=1)(
+        params, cfg, tokens, seq_lens, init_kv_cache(cfg, B, S)
+    )
+
+    # 2x4 mesh: DP over batch, TP over heads/ffn.
+    mesh = make_mesh(data=2, model=4)
+    sp = shard_pytree(params, param_pspecs(cfg, mesh), mesh)
+    cache = shard_pytree(
+        init_kv_cache(cfg, B, S), kv_cache_pspecs(cfg, mesh, B), mesh
+    )
+    dspec = data_pspec(mesh, B)
+    st = jax.device_put(tokens, NamedSharding(mesh, P(*dspec, None)))
+    sl = jax.device_put(seq_lens, NamedSharding(mesh, dspec))
+    logits, new_cache = jax.jit(prefill, static_argnums=1)(sp, cfg, st, sl, cache)
+
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(ref_logits), rtol=1e-5, atol=1e-5
+    )
+
+    # Decode one step on both and compare.
+    next_tok = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+    idx = jnp.full((B,), T)
+    ref_step, _ = jax.jit(decode_step, static_argnums=1)(
+        params, cfg, next_tok, idx, ref_cache
+    )
+    step, _ = jax.jit(decode_step, static_argnums=1)(sp, cfg, next_tok, idx, new_cache)
+    np.testing.assert_allclose(np.asarray(step), np.asarray(ref_step), rtol=1e-5, atol=1e-5)
+
+
+def test_pure_tp_8(cfg, params):
+    """model=8: d_ff=256 and vocab=384 shard; heads(4) and kv(1) replicate."""
+    B, T, S = 2, 5, 8
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (B, T), 0, 256)
+    seq_lens = jnp.full((B,), T)
+    ref, _ = jax.jit(prefill, static_argnums=1)(
+        params, cfg, tokens, seq_lens, init_kv_cache(cfg, B, S)
+    )
+    mesh = make_mesh(data=1, model=8)
+    sp = shard_pytree(params, param_pspecs(cfg, mesh), mesh)
+    cache = shard_pytree(init_kv_cache(cfg, B, S), kv_cache_pspecs(cfg, mesh, B), mesh)
+    logits, _ = jax.jit(prefill, static_argnums=1)(sp, cfg, tokens, seq_lens, cache)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref), rtol=1e-5, atol=1e-5)
