@@ -185,11 +185,13 @@ class Plan:
             if not isinstance(raw, Mapping):
                 problems.append(f"steps[{i}] must be an object")
                 continue
-            name = raw.get("service_name") or raw.get("name")
+            # Accepts the reference's field names (control_plane.py:61-62) and
+            # the compact grammar-constrained wire keys (planner/grammar.py).
+            name = raw.get("service_name") or raw.get("name") or raw.get("s")
             if not isinstance(name, str) or not name:
                 problems.append(f"steps[{i}] missing 'service_name'")
                 continue
-            input_keys = raw.get("input_keys") or []
+            input_keys = raw.get("input_keys") or raw.get("in") or []
             inputs: dict[str, str]
             if isinstance(input_keys, Mapping):
                 inputs = {str(k): str(v) for k, v in input_keys.items()}
@@ -201,7 +203,7 @@ class Plan:
             fb = raw.get("fallback")
             fallbacks = [fb] if isinstance(fb, str) and fb else []
             nodes.append(DagNode(name=name, inputs=inputs, fallbacks=fallbacks))
-            for nxt in raw.get("next_steps") or []:
+            for nxt in raw.get("next_steps") or raw.get("next") or []:
                 if isinstance(nxt, str):
                     edges.append(DagEdge(src=name, dst=nxt))
                 else:

@@ -1,0 +1,472 @@
+"""InferenceEngine: batched, grammar-constrained generation on TPU.
+
+The reference's "engine" is a blocking HTTPS call to OpenAI (reference
+``control_plane.py:69-73``, bug B6). This engine is the north star's
+replacement: an in-process serving stack where
+
+  - requests funnel through a thread-safe queue into a dedicated worker
+    thread; concurrent ``/plan`` intents coalesce into batches (iteration-
+    level batching with a short gather window) — 256 concurrent requests
+    become a few dozen batched decode loops (SURVEY.md §3.3);
+  - prefill is a jitted dense forward over bucketed (batch, length) shapes,
+    committed into the shared KV page pools in one scatter;
+  - decode is ONE jitted ``lax.while_loop`` carrying tokens, positions, DFA
+    states, done flags and the page pools — grammar masking, sampling and
+    KV writes all happen on-device with zero host round-trips per token;
+    pools and output buffers are donated, so decode updates in place;
+  - the KV page allocator runs host-side, single-writer, in the worker
+    thread (no allocator races by construction, SURVEY.md §5).
+
+Startup (mesh build, weight load, warmup compiles) is an explicit,
+observable phase: ``state`` moves cold → warming → ready and ``/healthz``
+reports it (SURVEY.md §3.4).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import functools
+import queue
+import threading
+import time
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mcpx.core.config import MCPXConfig
+from mcpx.core.errors import EngineError
+from mcpx.engine.kv_cache import PageAllocator, commit_prefill_to_pages, init_paged_kv
+from mcpx.engine.paged_decode import decode_step_paged
+from mcpx.engine.sampling import sample
+from mcpx.models.gemma.config import GemmaConfig
+from mcpx.models.gemma.model import init_kv_cache, prefill
+from mcpx.models.gemma.params import load_or_init
+from mcpx.models.tokenizer import ByteTokenizer
+from mcpx.planner.grammar import PlanGrammar, build_plan_grammar
+from mcpx.telemetry.metrics import Metrics
+
+
+@dataclasses.dataclass
+class GenerateRequest:
+    prompt_ids: list[int]
+    max_new_tokens: int
+    constrained: bool
+    temperature: float
+    future: "asyncio.Future[GenerateResult]"
+    loop: asyncio.AbstractEventLoop
+    enqueued_at: float
+
+
+@dataclasses.dataclass
+class GenerateResult:
+    token_ids: list[int]
+    text: str
+    prompt_tokens: int
+    generated_tokens: int
+    queue_ms: float
+    prefill_ms: float
+    decode_ms: float
+
+
+def _bucket(n: int, buckets: tuple[int, ...]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    raise EngineError(f"length {n} exceeds largest bucket {buckets[-1]}")
+
+
+class InferenceEngine:
+    def __init__(
+        self,
+        config: Optional[MCPXConfig] = None,
+        model_cfg: Optional[GemmaConfig] = None,
+        mesh=None,
+        metrics: Optional[Metrics] = None,
+    ) -> None:
+        self.config = config or MCPXConfig()
+        ecfg = self.config.engine
+        self.model_cfg = model_cfg or GemmaConfig.named(
+            self.config.model.size, max_seq_len=self.config.model.max_seq_len
+        )
+        self.tokenizer = ByteTokenizer()
+        self.grammar: PlanGrammar = build_plan_grammar(self.tokenizer)
+        self.metrics = metrics or Metrics()
+        self.state = "cold"
+        self._mesh = mesh
+        self._queue: "queue.Queue[Optional[GenerateRequest]]" = queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+        self._started = threading.Event()
+        self._stop = False
+        self._startup_error: Optional[BaseException] = None
+        # Device state (worker thread only after start):
+        self._params = None
+        self._paged_kv = None
+        self._allocator = PageAllocator(
+            n_pages=max(
+                2,
+                ecfg.max_batch_size * ecfg.max_pages_per_seq + 1,
+            ),
+            page_size=ecfg.kv_page_size,
+            max_pages_per_seq=ecfg.max_pages_per_seq,
+        )
+        self._prefill_buckets = tuple(
+            b
+            for b in (64, 128, 256, 512, 1024, 2048)
+            if b <= self.model_cfg.max_seq_len and b % ecfg.kv_page_size == 0
+        )
+        if not self._prefill_buckets:
+            raise EngineError(
+                f"no usable prefill bucket <= max_seq_len={self.model_cfg.max_seq_len} "
+                f"that is a multiple of kv_page_size={ecfg.kv_page_size}"
+            )
+        # Always include max_batch_size itself so a fully-gathered batch
+        # has a bucket.
+        self._batch_buckets = tuple(
+            sorted(
+                {b for b in (1, 2, 4, 8, 16, 32, 64) if b < ecfg.max_batch_size}
+                | {ecfg.max_batch_size}
+            )
+        )
+        # DFA tables on device.
+        self._dfa_trans = jnp.asarray(self.grammar.transitions)
+        self._dfa_mask = jnp.asarray(self.grammar.mask)
+
+    # ------------------------------------------------------------- lifecycle
+    async def start(self) -> None:
+        """Build mesh, load weights, compile, spin up the worker thread."""
+        if self.state != "cold":
+            return
+        self.state = "warming"
+        self._thread = threading.Thread(target=self._worker, daemon=True, name="mcpx-engine")
+        self._thread.start()
+        while not self._started.is_set():
+            await asyncio.sleep(0.02)
+        if self._startup_error is not None:
+            self.state = "failed"
+            raise EngineError(f"engine startup failed: {self._startup_error}")
+        self.state = "ready"
+
+    async def aclose(self) -> None:
+        self._stop = True
+        self._queue.put(None)
+        if self._thread is not None:
+            await asyncio.to_thread(self._thread.join, 5.0)
+
+    # ------------------------------------------------------------------ api
+    async def generate(
+        self,
+        prompt_ids: list[int],
+        *,
+        max_new_tokens: int = 0,
+        constrained: bool = True,
+        temperature: Optional[float] = None,
+    ) -> GenerateResult:
+        if self.state != "ready":
+            raise EngineError(f"engine not ready (state={self.state})")
+        ecfg = self.config.engine
+        req = GenerateRequest(
+            prompt_ids=list(prompt_ids),
+            max_new_tokens=max_new_tokens or ecfg.max_decode_len,
+            constrained=constrained,
+            temperature=ecfg.temperature if temperature is None else temperature,
+            future=asyncio.get_running_loop().create_future(),
+            loop=asyncio.get_running_loop(),
+            enqueued_at=time.monotonic(),
+        )
+        self._queue.put(req)
+        return await req.future
+
+    # ------------------------------------------------------------ internals
+    def _setup(self) -> None:
+        from mcpx.parallel.mesh import make_mesh
+
+        ecfg = self.config.engine
+        if self._mesh is None:
+            n = len(jax.devices())
+            model_axis = min(ecfg.model_axis, n)
+            data_axis = min(ecfg.data_axis, max(1, n // model_axis))
+            self._mesh = make_mesh(data=data_axis, model=model_axis)
+        self._params, source = load_or_init(
+            self.model_cfg, self.config.model.checkpoint_path, self._mesh
+        )
+        self._paged_kv = init_paged_kv(
+            self.model_cfg, self._allocator.n_pages, ecfg.kv_page_size
+        )
+        self._jit_prefill = jax.jit(
+            functools.partial(self._prefill_impl),
+            static_argnames=("T",),
+            donate_argnames=("paged_k", "paged_v"),
+        )
+        self._jit_decode = jax.jit(
+            functools.partial(self._decode_impl),
+            static_argnames=("steps", "temperature", "constrained"),
+            donate_argnames=("paged_k", "paged_v", "out_buf"),
+        )
+
+    # --- jitted bodies ----------------------------------------------------
+    def _prefill_impl(self, params, tokens, seq_lens, paged_k, paged_v, page_table, *, T):
+        cfg = self.model_cfg
+        B = tokens.shape[0]
+        dense = init_kv_cache(cfg, B, T)
+        logits, dense = prefill(params, cfg, tokens, seq_lens, dense)
+        paged = commit_prefill_to_pages(
+            {"k": paged_k, "v": paged_v},
+            dense,
+            page_table,
+            seq_lens,
+            self.config.engine.kv_page_size,
+        )
+        last = logits[jnp.arange(B), seq_lens - 1]  # [B, V]
+        return last, paged["k"], paged["v"]
+
+    def _decode_impl(
+        self,
+        params,
+        first_logits,
+        seq_lens,
+        budgets,
+        page_table,
+        paged_k,
+        paged_v,
+        out_buf,
+        active,
+        key,
+        *,
+        steps: int,
+        temperature: float,
+        constrained: bool,
+    ):
+        cfg = self.model_cfg
+        tok = self.tokenizer
+        B = seq_lens.shape[0]
+        trans, mask_tab = self._dfa_trans, self._dfa_mask
+        start_state = jnp.full((B,), self.grammar.start_state, jnp.int32)
+
+        key, sub = jax.random.split(key)
+        mask0 = mask_tab[start_state] if constrained else None
+        first = sample(first_logits, sub, temperature=temperature, top_k=self.config.engine.top_k, mask=mask0)
+        first = first.astype(jnp.int32)
+        done0 = (first == tok.eos_id) | ~active | (budgets < 1)
+        cur0 = jnp.where(done0, tok.pad_id, first)
+        state0 = trans[start_state, cur0]
+
+        def cond(c):
+            i, cur, pos, st, done, k_p, v_p, buf, key = c
+            return (i < steps) & jnp.any(~done)
+
+        def body(c):
+            i, cur, pos, st, done, k_p, v_p, buf, key = c
+            buf = buf.at[:, i].set(jnp.where(done, tok.pad_id, cur))
+            logits, kv = decode_step_paged(
+                params,
+                cfg,
+                cur,
+                pos,
+                page_table,
+                {"k": k_p, "v": v_p},
+                use_pallas=self.config.engine.use_pallas,
+                interpret=self.config.engine.interpret,
+            )
+            key, sub = jax.random.split(key)
+            mask = mask_tab[st] if constrained else None
+            nxt = sample(
+                logits, sub, temperature=temperature, top_k=self.config.engine.top_k, mask=mask
+            ).astype(jnp.int32)
+            # Per-sequence budget: sequence b has emitted i+1 tokens after
+            # this step (buf[:, i] above); stop at its own max_new_tokens.
+            newly_done = done | (nxt == tok.eos_id) | (i + 1 >= budgets)
+            nxt = jnp.where(newly_done, tok.pad_id, nxt)
+            st = trans[st, nxt]
+            pos = jnp.where(newly_done, pos, pos + 1)
+            return (i + 1, nxt, pos, st, newly_done, kv["k"], kv["v"], buf, key)
+
+        init = (
+            jnp.asarray(0, jnp.int32),
+            cur0,
+            seq_lens,
+            state0,
+            done0,
+            paged_k,
+            paged_v,
+            out_buf,
+            key,
+        )
+        i, cur, pos, st, done, k_p, v_p, buf, key = jax.lax.while_loop(cond, body, init)
+        return buf, st, done, k_p, v_p
+
+    # --- worker -----------------------------------------------------------
+    def _worker(self) -> None:
+        try:
+            self._setup()
+        except BaseException as e:  # noqa: BLE001 - surfaced to start()
+            self._startup_error = e
+            self._started.set()
+            return
+        self._started.set()
+        gather_window_s = 0.003
+        pending: list[GenerateRequest] = []
+        while not self._stop:
+            if not pending:
+                try:
+                    first = self._queue.get(timeout=0.1)
+                except queue.Empty:
+                    continue
+                if first is None:
+                    break
+                pending.append(first)
+            # Gather more requests within the batching window.
+            deadline = time.monotonic() + gather_window_s
+            while len(pending) < self.config.engine.max_batch_size:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    nxt = self._queue.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    self._stop = True
+                    break
+                pending.append(nxt)
+            if not pending:
+                continue
+            # Only requests with identical sampling semantics share a fused
+            # decode loop (constrained flag and temperature are batch-wide);
+            # the rest stay pending for the next round.
+            head = pending[0]
+            compat = [
+                r
+                for r in pending
+                if r.constrained == head.constrained and r.temperature == head.temperature
+            ][: self.config.engine.max_batch_size]
+            rest = [r for r in pending if r not in compat]
+            pending = rest
+            self._process_batch(compat)
+
+    def _process_batch(self, batch: list[GenerateRequest]) -> None:
+        try:
+            results = self._run_batch(batch)
+            for req, res in zip(batch, results):
+                req.loop.call_soon_threadsafe(_resolve, req.future, res, None)
+        except BaseException as e:  # noqa: BLE001 - propagate to callers
+            for req in batch:
+                req.loop.call_soon_threadsafe(_resolve, req.future, None, e)
+
+    def _run_batch(self, batch: list[GenerateRequest]) -> list[GenerateResult]:
+        ecfg = self.config.engine
+        tok = self.tokenizer
+        t_start = time.monotonic()
+        B_real = len(batch)
+        B = _bucket(B_real, self._batch_buckets)
+        max_new = max(r.max_new_tokens for r in batch)
+        steps = min(max_new, ecfg.max_decode_len)
+        # Prompts are trimmed to their tail (most recent context) so they fit
+        # both the largest prefill bucket and the per-sequence page budget
+        # (capacity must leave room for the decode steps).
+        capacity = ecfg.max_pages_per_seq * ecfg.kv_page_size
+        if steps >= capacity:
+            raise EngineError(
+                f"decode budget {steps} exceeds page capacity {capacity} "
+                f"(max_pages_per_seq*kv_page_size)"
+            )
+        longest = min(self._prefill_buckets[-1], capacity - steps)
+        max_prompt = min(longest, max(len(r.prompt_ids) for r in batch))
+        T = _bucket(max_prompt, self._prefill_buckets)
+
+        tokens = np.full((B, T), tok.pad_id, np.int32)
+        seq_lens = np.ones((B,), np.int32)
+        active = np.zeros((B,), bool)
+        for i, r in enumerate(batch):
+            ids = r.prompt_ids[-longest:][-T:]
+            tokens[i, : len(ids)] = ids
+            seq_lens[i] = len(ids)
+            active[i] = True
+
+        # Pages for prompt + decode budget, allocated up front so the page
+        # table is static across the fused decode loop.
+        page_table = np.zeros((B, ecfg.max_pages_per_seq), np.int32)
+        seq_ids = []
+        for i in range(B_real):
+            sid = (id(batch[i]), i)
+            pages = self._allocator.allocate(sid, int(seq_lens[i]) + steps)
+            page_table[i, : len(pages)] = pages
+            seq_ids.append(sid)
+        self.metrics.kv_page_utilization.set(self._allocator.stats().utilization)
+        self.metrics.batch_occupancy.set(B_real)
+
+        budgets = np.zeros((B,), np.int32)
+        for i, r in enumerate(batch):
+            budgets[i] = min(r.max_new_tokens, steps)
+        try:
+            t0 = time.monotonic()
+            last_logits, k_p, v_p = self._jit_prefill(
+                self._params,
+                jnp.asarray(tokens),
+                jnp.asarray(seq_lens),
+                self._paged_kv["k"],
+                self._paged_kv["v"],
+                jnp.asarray(page_table),
+                T=T,
+            )
+            # Pools were donated to prefill: point at the live buffers
+            # immediately so an exception below can't leave stale handles.
+            self._paged_kv = {"k": k_p, "v": v_p}
+            out_buf = jnp.full((B, steps), tok.pad_id, jnp.int32)
+            # The worker only batches requests with identical sampling
+            # semantics (see _worker), so these are batch-wide by invariant.
+            constrained = batch[0].constrained
+            temperature = batch[0].temperature
+            buf, st, done, k_p, v_p = self._jit_decode(
+                self._params,
+                last_logits,
+                jnp.asarray(seq_lens),
+                jnp.asarray(budgets),
+                jnp.asarray(page_table),
+                k_p,
+                v_p,
+                out_buf,
+                jnp.asarray(active),
+                jax.random.PRNGKey(int(t0 * 1e6) & 0x7FFFFFFF),
+                steps=steps,
+                temperature=temperature,
+                constrained=constrained,
+            )
+            self._paged_kv = {"k": k_p, "v": v_p}
+            buf_np = np.asarray(jax.device_get(buf))
+            t1 = time.monotonic()
+        finally:
+            for sid in seq_ids:
+                self._allocator.free(sid)
+            self.metrics.kv_page_utilization.set(self._allocator.stats().utilization)
+
+        results = []
+        gen_total = 0
+        for i, r in enumerate(batch):
+            ids = [int(t) for t in buf_np[i] if t != tok.pad_id]
+            gen_total += len(ids)
+            results.append(
+                GenerateResult(
+                    token_ids=ids,
+                    text=tok.decode(ids),
+                    prompt_tokens=len(r.prompt_ids),
+                    generated_tokens=len(ids),
+                    queue_ms=(t0 - r.enqueued_at) * 1e3,
+                    prefill_ms=(t1 - t0) * 1e3,  # combined below
+                    decode_ms=(t1 - t0) * 1e3,
+                )
+            )
+        self.metrics.decode_tokens.inc(gen_total)
+        self.metrics.batch_occupancy.set(0)
+        return results
+
+
+def _resolve(future: "asyncio.Future", result, error) -> None:
+    if future.cancelled():
+        return
+    if error is not None:
+        future.set_exception(error)
+    else:
+        future.set_result(result)

@@ -1,0 +1,181 @@
+"""Ragged paged-attention decode kernel (Pallas TPU) + jnp reference.
+
+One decode step of attention for a batch of sequences whose KV lives in a
+shared page pool (``mcpx.engine.kv_cache`` layout: kv-head-major
+``[K, N_pages, page_size, head_dim]`` per layer). Grid is ``(B, K)``; each
+program DMAs its sequence's pages HBM→VMEM one at a time and accumulates
+flash-style (online softmax in fp32), so
+  - no ``[B, S_max]`` dense cache is ever materialised (ragged batches share
+    the pool — the RPA paper's point, PAPERS.md),
+  - per-page tiles are ``[page_size, head_dim]`` — contiguous,
+    lane-aligned (head_dim multiple of 128), no in-kernel transposes,
+  - arithmetic is ``q [G, hd] @ k.T -> [G, page_size]`` then
+    ``p @ v -> [G, hd]``: MXU matmuls with GQA group size G rows.
+
+The jnp reference implements identical semantics by gathering pages; kernel
+tests assert exact agreement in interpret mode on CPU (SURVEY.md §4.2) and
+on real TPU in the benchmark harness.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------- reference
+def paged_attention_reference(
+    q: jax.Array,  # [B, K, G, hd]
+    k_pages: jax.Array,  # [K, N, Psz, hd]
+    v_pages: jax.Array,  # [K, N, Psz, hd]
+    page_table: jax.Array,  # [B, Pmax] int32
+    seq_lens: jax.Array,  # [B] int32 (tokens valid in cache, incl. current)
+) -> jax.Array:
+    """Pure-jnp semantics reference; returns [B, K, G, hd] in q.dtype."""
+    B, K, G, hd = q.shape
+    _, _, psz, _ = k_pages.shape
+    p_max = page_table.shape[1]
+    # Gather pages: [B, K, Pmax*Psz, hd]
+    k = k_pages[:, page_table].transpose(1, 0, 2, 3, 4).reshape(B, K, p_max * psz, hd)
+    v = v_pages[:, page_table].transpose(1, 0, 2, 3, 4).reshape(B, K, p_max * psz, hd)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+    logits = jnp.einsum("bkgh,bksh->bkgs", q, k, preferred_element_type=jnp.float32)
+    logits = logits * scale
+    pos = jnp.arange(p_max * psz)
+    mask = pos[None, :] < seq_lens[:, None]  # [B, S]
+    logits = jnp.where(mask[:, None, None, :], logits, NEG_INF)
+    weights = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bkgs,bksh->bkgh", weights.astype(v.dtype), v)
+    return out.astype(q.dtype)
+
+
+# ------------------------------------------------------------------- kernel
+def _kernel(
+    # scalar prefetch
+    page_table_ref,  # [B, Pmax] SMEM
+    seq_lens_ref,  # [B] SMEM
+    # blocks
+    q_ref,  # [1, 1, G, hd] VMEM
+    k_pages_ref,  # [K, N, Psz, hd] ANY (stays in HBM)
+    v_pages_ref,
+    out_ref,  # [1, 1, G, hd] VMEM
+    # scratch
+    k_buf,  # [2, Psz, hd] VMEM
+    v_buf,
+    sem_k,  # DMA sems [2]
+    sem_v,
+    *,
+    page_size: int,
+    max_pages: int,
+):
+    b = pl.program_id(0)
+    kh = pl.program_id(1)
+    seq_len = seq_lens_ref[b]
+    n_pages = pl.cdiv(seq_len, page_size)
+    G, hd = q_ref.shape[2], q_ref.shape[3]
+
+    q = q_ref[0, 0].astype(jnp.float32)  # [G, hd]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+
+    def dma_k(slot, page_idx):
+        return pltpu.make_async_copy(
+            k_pages_ref.at[kh, page_table_ref[b, page_idx]], k_buf.at[slot], sem_k.at[slot]
+        )
+
+    def dma_v(slot, page_idx):
+        return pltpu.make_async_copy(
+            v_pages_ref.at[kh, page_table_ref[b, page_idx]], v_buf.at[slot], sem_v.at[slot]
+        )
+
+    @pl.when(n_pages > 0)
+    def _():
+        dma_k(0, 0).start()
+        dma_v(0, 0).start()
+
+    def body(i, carry):
+        m, l, acc = carry  # [G, 1], [G, 1], [G, hd] fp32
+        slot = lax.rem(i, 2)
+        nxt = lax.rem(i + 1, 2)
+
+        @pl.when(i + 1 < n_pages)
+        def _():
+            dma_k(nxt, i + 1).start()
+            dma_v(nxt, i + 1).start()
+
+        dma_k(slot, i).wait()
+        dma_v(slot, i).wait()
+        k_tile = k_buf[slot].astype(jnp.float32)  # [Psz, hd]
+        v_tile = v_buf[slot].astype(jnp.float32)
+
+        s = lax.dot_general(
+            q, k_tile, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [G, Psz]
+        s = s * scale
+        pos = i * page_size + lax.broadcasted_iota(jnp.int32, (1, page_size), 1)
+        s = jnp.where(pos < seq_len, s, NEG_INF)
+
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))  # [G, 1]
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)  # [G, Psz]
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + lax.dot_general(
+            p, v_tile, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((G, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((G, 1), jnp.float32)
+    acc0 = jnp.zeros((G, hd), jnp.float32)
+    m, l, acc = lax.fori_loop(0, n_pages, body, (m0, l0, acc0))
+    out = jnp.where(l > 0.0, acc / jnp.maximum(l, 1e-30), 0.0)
+    out_ref[0, 0] = out.astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_attention(
+    q: jax.Array,  # [B, K, G, hd]
+    k_pages: jax.Array,  # [K, N, Psz, hd]
+    v_pages: jax.Array,
+    page_table: jax.Array,  # [B, Pmax]
+    seq_lens: jax.Array,  # [B]
+    *,
+    interpret: bool = False,
+) -> jax.Array:
+    B, K, G, hd = q.shape
+    _, _, page_size, _ = k_pages.shape
+    max_pages = page_table.shape[1]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, K),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, G, hd), lambda b, k, *_: (b, k, 0, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, G, hd), lambda b, k, *_: (b, k, 0, 0), memory_space=pltpu.VMEM
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, page_size, hd), k_pages.dtype),
+            pltpu.VMEM((2, page_size, hd), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    kernel = functools.partial(_kernel, page_size=page_size, max_pages=max_pages)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), seq_lens.astype(jnp.int32), q, k_pages, v_pages)

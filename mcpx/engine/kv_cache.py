@@ -1,0 +1,189 @@
+"""Paged KV cache: host-side block allocator + device-side page pools.
+
+vLLM-style paging re-designed for TPU (see PAPERS.md "Ragged Paged
+Attention ... for TPU"): the device holds per-layer K/V page pools laid out
+**kv-head-major** — ``[L, K, N_pages, page_size, head_dim]`` — so the decode
+kernel's per-(batch, kv-head) grid step DMAs one contiguous ``[page_size,
+head_dim]`` tile per page, an MXU/VPU-friendly block with no in-kernel
+transposes. The ``K`` axis shards over the mesh's ``model`` axis when
+divisible (GQA); MQA replicates KV, the standard MQA-TP layout.
+
+The allocator is deliberately host-side, synchronous, single-writer (the
+scheduler owns it): allocation is bookkeeping, not compute, and a single
+writer makes the paged-KV races SURVEY.md §5 worries about structurally
+impossible. Invariants are enforced and tested (alloc/free balance, no
+double-free, no page aliasing).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from mcpx.core.errors import EngineError
+from mcpx.models.gemma.config import GemmaConfig
+
+
+@dataclass
+class PageStats:
+    total_pages: int
+    free_pages: int
+    sequences: int
+
+    @property
+    def utilization(self) -> float:
+        return 1.0 - self.free_pages / max(1, self.total_pages)
+
+
+class PageAllocator:
+    """Free-list page allocator; page 0 is reserved as the null page."""
+
+    def __init__(self, n_pages: int, page_size: int, max_pages_per_seq: int) -> None:
+        if n_pages < 2:
+            raise EngineError("need at least 2 pages (page 0 is reserved)")
+        self.page_size = page_size
+        self.max_pages_per_seq = max_pages_per_seq
+        self.n_pages = n_pages
+        self._free: list[int] = list(range(n_pages - 1, 0, -1))  # stack; 0 reserved
+        self._seq_pages: dict[int, list[int]] = {}
+
+    # ------------------------------------------------------------------ api
+    def can_allocate(self, n_tokens: int) -> bool:
+        return len(self._free) >= self.pages_needed(n_tokens)
+
+    def pages_needed(self, n_tokens: int) -> int:
+        return max(1, -(-n_tokens // self.page_size))
+
+    def allocate(self, seq_id: int, n_tokens: int) -> list[int]:
+        """Allocate pages to hold ``n_tokens``; returns the page list."""
+        if seq_id in self._seq_pages:
+            raise EngineError(f"sequence {seq_id} already has pages")
+        need = self.pages_needed(n_tokens)
+        if need > self.max_pages_per_seq:
+            raise EngineError(
+                f"sequence needs {need} pages > max_pages_per_seq={self.max_pages_per_seq}"
+            )
+        if need > len(self._free):
+            raise EngineError(f"out of KV pages: need {need}, free {len(self._free)}")
+        pages = [self._free.pop() for _ in range(need)]
+        self._seq_pages[seq_id] = pages
+        return list(pages)
+
+    def extend(self, seq_id: int, n_tokens_total: int) -> list[int]:
+        """Grow a sequence's page list to cover ``n_tokens_total``; returns
+        the (possibly unchanged) full page list."""
+        pages = self._seq_pages.get(seq_id)
+        if pages is None:
+            raise EngineError(f"unknown sequence {seq_id}")
+        need = self.pages_needed(n_tokens_total)
+        if need > self.max_pages_per_seq:
+            raise EngineError(
+                f"sequence {seq_id} exceeds max_pages_per_seq={self.max_pages_per_seq}"
+            )
+        while len(pages) < need:
+            if not self._free:
+                raise EngineError("out of KV pages during extend")
+            pages.append(self._free.pop())
+        return list(pages)
+
+    def free(self, seq_id: int) -> None:
+        pages = self._seq_pages.pop(seq_id, None)
+        if pages is None:
+            return
+        for p in pages:
+            if p <= 0 or p >= self.n_pages:
+                raise EngineError(f"corrupt page id {p}")
+            self._free.append(p)
+
+    def pages_of(self, seq_id: int) -> list[int]:
+        return list(self._seq_pages.get(seq_id, []))
+
+    def stats(self) -> PageStats:
+        return PageStats(
+            total_pages=self.n_pages,
+            free_pages=len(self._free),
+            sequences=len(self._seq_pages),
+        )
+
+    def check_invariants(self) -> None:
+        """Test hook: free list + allocated pages partition [1, n_pages)."""
+        seen: set[int] = set()
+        for p in self._free:
+            if p in seen:
+                raise EngineError(f"page {p} double-present in free list")
+            seen.add(p)
+        for seq, pages in self._seq_pages.items():
+            for p in pages:
+                if p in seen:
+                    raise EngineError(f"page {p} aliased (seq {seq})")
+                seen.add(p)
+        if seen != set(range(1, self.n_pages)):
+            raise EngineError("page leak: free+allocated != all pages")
+
+
+# ------------------------------------------------------------------- device
+def init_paged_kv(
+    cfg: GemmaConfig, n_pages: int, page_size: int, dtype: str | None = None
+) -> dict[str, jax.Array]:
+    """Device page pools: ``[L, K, N_pages, page_size, head_dim]``."""
+    d = jnp.dtype(dtype or cfg.dtype)
+    shape = (cfg.n_layers, cfg.n_kv_heads, n_pages, page_size, cfg.head_dim)
+    return {"k": jnp.zeros(shape, d), "v": jnp.zeros(shape, d)}
+
+
+def commit_prefill_to_pages(
+    paged: dict[str, jax.Array],
+    dense: dict[str, jax.Array],
+    page_table: jax.Array,
+    seq_lens: jax.Array,
+    page_size: int,
+) -> dict[str, jax.Array]:
+    """Scatter a dense prefill cache ``[L, B, T, K, hd]`` into the page pools.
+
+    ``page_table`` is [B, Pmax] int32 (0 = null page). Chunks beyond a
+    sequence's pages are routed to the reserved null page 0, which is never
+    read (positions are masked by seq_lens at attention time).
+    """
+    L, B, T, K, hd = dense["k"].shape
+    p_max = page_table.shape[1]
+    n_chunks = T // page_size
+    if T % page_size:
+        raise EngineError(f"prefill length {T} not a multiple of page_size {page_size}")
+
+    def scatter(pool: jax.Array, dense_arr: jax.Array) -> jax.Array:
+        # dense [L, B, T, K, hd] -> [L, K, B*n_chunks, page_size, hd]
+        chunks = dense_arr.reshape(L, B, n_chunks, page_size, K, hd)
+        chunks = chunks.transpose(0, 4, 1, 2, 3, 5).reshape(
+            L, K, B * n_chunks, page_size, hd
+        )
+        dest = page_table[:, :n_chunks].reshape(B * n_chunks)  # page id per chunk
+        return pool.at[:, :, dest].set(chunks, mode="drop")
+
+    return {"k": scatter(paged["k"], dense["k"]), "v": scatter(paged["v"], dense["v"])}
+
+
+def write_decode_kv(
+    paged: dict[str, jax.Array],
+    k_new: jax.Array,
+    v_new: jax.Array,
+    page_table: jax.Array,
+    positions: jax.Array,
+) -> dict[str, jax.Array]:
+    """Write one decode step's K/V ``[L, B, K, hd]`` at ``positions`` [B].
+
+    The target page is ``page_table[b, pos // page_size]``, slot
+    ``pos % page_size``.
+    """
+    page_size = paged["k"].shape[3]
+    chunk = positions // page_size  # [B]
+    slot = positions % page_size  # [B]
+    b_idx = jnp.arange(positions.shape[0])
+    pages = page_table[b_idx, chunk]  # [B]
+    # [L, B, K, hd] -> pool [L, K, n_pages, page_size, hd]
+    k_t = k_new.transpose(0, 2, 1, 3)  # [L, K, B, hd]
+    v_t = v_new.transpose(0, 2, 1, 3)
+    out_k = paged["k"].at[:, :, pages, slot].set(k_t, mode="drop")
+    out_v = paged["v"].at[:, :, pages, slot].set(v_t, mode="drop")
+    return {"k": out_k, "v": out_v}

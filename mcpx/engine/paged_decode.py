@@ -1,0 +1,87 @@
+"""Decode-step forward pass against the paged KV cache.
+
+Same math as ``mcpx.models.gemma.model`` (shares its RMSNorm/RoPE
+primitives and param pytree) but the attention reads/writes go to the shared
+page pools via the Pallas ragged paged-attention kernel
+(``engine/kernels/paged_attention.py``) instead of a dense per-batch cache.
+Kept separate from the model so the dense path stays a clean correctness
+reference (SURVEY.md §4.2) and the paged path owns its layout decisions.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from mcpx.engine.kernels.paged_attention import (
+    paged_attention,
+    paged_attention_reference,
+)
+from mcpx.models.gemma.config import GemmaConfig
+from mcpx.models.gemma.model import apply_rope, rms_norm
+
+
+def decode_step_paged(
+    params: dict[str, Any],
+    cfg: GemmaConfig,
+    tokens: jax.Array,  # [B] int32
+    positions: jax.Array,  # [B] int32 — slot this token is written to
+    page_table: jax.Array,  # [B, Pmax] int32
+    paged_kv: dict[str, jax.Array],  # k/v: [L, K, N, Psz, hd]
+    *,
+    use_pallas: bool = True,
+    interpret: bool = False,
+) -> tuple[jax.Array, dict[str, jax.Array]]:
+    """One decode step for the whole batch; returns ([B, V] logits, pools)."""
+    B = tokens.shape[0]
+    psz = paged_kv["k"].shape[3]
+    x = params["embed"][tokens].astype(jnp.dtype(cfg.dtype))  # [B, D]
+    x = x * jnp.asarray(math.sqrt(cfg.d_model), x.dtype)
+
+    b_idx = jnp.arange(B)
+    pages = page_table[b_idx, positions // psz]  # [B]
+    slots = positions % psz  # [B]
+    seq_lens = positions + 1  # attend through the just-written token
+
+    def attend(q, k_pool, v_pool):
+        qg = q.reshape(B, cfg.n_kv_heads, cfg.q_per_kv, cfg.head_dim)
+        if use_pallas:
+            out = paged_attention(qg, k_pool, v_pool, page_table, seq_lens, interpret=interpret)
+        else:
+            out = paged_attention_reference(qg, k_pool, v_pool, page_table, seq_lens)
+        return out.reshape(B, cfg.n_heads * cfg.head_dim)
+
+    def body(carry, scanned):
+        x = carry  # [B, D]
+        lp, k_pool, v_pool = scanned  # pools: [K, N, Psz, hd]
+        h = rms_norm(x, lp["pre_attn_norm"], cfg.norm_eps)
+        q = jnp.einsum("bd,dkh->bkh", h, lp["wq"])  # [B, H, hd]
+        k = jnp.einsum("bd,dkh->bkh", h, lp["wk"])  # [B, K, hd]
+        v = jnp.einsum("bd,dkh->bkh", h, lp["wv"])
+        q = apply_rope(q[:, None], positions[:, None], cfg.rope_theta)[:, 0]
+        k = apply_rope(k[:, None], positions[:, None], cfg.rope_theta)[:, 0]
+        k_pool = k_pool.at[:, pages, slots].set(
+            k.transpose(1, 0, 2).astype(k_pool.dtype)
+        )
+        v_pool = v_pool.at[:, pages, slots].set(
+            v.transpose(1, 0, 2).astype(v_pool.dtype)
+        )
+        attn = attend(q, k_pool, v_pool)
+        wo = lp["wo"].reshape(cfg.n_heads * cfg.head_dim, cfg.d_model)
+        x = x + jnp.einsum("bf,fd->bd", attn, wo)
+        h = rms_norm(x, lp["pre_mlp_norm"], cfg.norm_eps)
+        ff = jax.nn.gelu(jnp.einsum("bd,df->bf", h, lp["w_gate"]), approximate=True)
+        ff = ff * jnp.einsum("bd,df->bf", h, lp["w_up"])
+        x = x + jnp.einsum("bf,fd->bd", ff, lp["w_down"])
+        return x, (k_pool, v_pool)
+
+    x, (k_new, v_new) = lax.scan(
+        body, x, (params["layers"], paged_kv["k"], paged_kv["v"])
+    )
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum("bd,vd->bv", x, params["embed"], preferred_element_type=jnp.float32)
+    return logits, {"k": k_new, "v": v_new}

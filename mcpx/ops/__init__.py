@@ -1,0 +1,8 @@
+"""Public op surface: TPU kernels and their reference implementations."""
+
+from mcpx.engine.kernels.paged_attention import (
+    paged_attention,
+    paged_attention_reference,
+)
+
+__all__ = ["paged_attention", "paged_attention_reference"]

@@ -1,0 +1,173 @@
+"""Byte-level DFA for grammar-constrained DAG-plan decoding.
+
+The reference ``json.loads``'s raw LLM text and crashes on anything else
+(bug B7, reference ``control_plane.py:74``). Here structural validity is
+enforced *during* decoding: because the in-tree tokenizer is byte-level
+(``mcpx.models.tokenizer``), a deterministic finite automaton over bytes IS
+an automaton over tokens — so the grammar compiles to two device arrays
+
+  - ``transitions``: int32 ``[n_states, vocab]``  (next state per token)
+  - ``mask``:        bool  ``[n_states, vocab]``  (allowed next tokens)
+
+and the **entire constrained decode loop runs on-device** inside ``lax.scan``
+(state gather → logit mask → sample → state transition), with zero host
+round-trips per token. This is the TPU-native answer to SGLang-style
+constrained decoding (PAPERS.md): the automaton is data, not control flow.
+
+The grammar accepted is the planner wire shape (compact keys to cut decode
+length; normalised by ``Plan.from_wire``):
+
+    {"steps":[{"s":"<service>","in":["<key>",...],"next":["<service>",...]},...]}
+
+Strings accept any non-control byte except ``"`` and ``\\`` (no escapes —
+service names and keys are identifier-like). Nesting is fixed-depth, so a
+DFA suffices (no pushdown needed). EOS is legal exactly in the accept state.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from mcpx.models.tokenizer import ByteTokenizer
+
+# Bytes permitted inside strings: printable ASCII minus quote and backslash.
+# ASCII-only keeps decode(encode(x)) byte-faithful regardless of what the
+# model samples (arbitrary high bytes could form invalid UTF-8, which the
+# tokenizer's replacement-char decoding would silently rewrite); service
+# names and payload keys are identifier-like, so ASCII loses nothing.
+_STRING_BYTES = [b for b in range(0x20, 0x7F) if b not in (0x22, 0x5C)]
+_QUOTE = 0x22
+
+
+class _Builder:
+    def __init__(self) -> None:
+        self.transitions: list[dict[int, int]] = []
+        self.eos_ok: set[int] = set()
+
+    def state(self) -> int:
+        self.transitions.append({})
+        return len(self.transitions) - 1
+
+    def link(self, src: int, byte: int, dst: int) -> None:
+        existing = self.transitions[src].get(byte)
+        if existing is not None and existing != dst:
+            raise ValueError(f"nondeterministic byte {byte:#x} at state {src}")
+        self.transitions[src][byte] = dst
+
+    def literal(self, src: int, text: str) -> int:
+        cur = src
+        for b in text.encode("utf-8"):
+            nxt = self.state()
+            self.link(cur, b, nxt)
+            cur = nxt
+        return cur
+
+    def string_content(self, entry: int) -> int:
+        """``entry`` is the state right after an opening quote. Strings must
+        be non-empty (an empty service/key name is grammar-valid JSON that
+        ``Plan.from_wire`` would still reject — so the DFA forbids it): the
+        first content byte moves to a loop state, and only the loop state
+        may close the string. Returns the post-quote state."""
+        loop = self.state()
+        exit_state = self.state()
+        for b in _STRING_BYTES:
+            self.link(entry, b, loop)
+            self.link(loop, b, loop)
+        self.link(loop, _QUOTE, exit_state)
+        return exit_state
+
+    def string_list(self, entry: int) -> int:
+        """``entry`` is the state right after ``[``. Accepts ``]`` (empty) or
+        ``"s"(,"s")*]``. Returns the post-``]`` state."""
+        exit_state = self.state()
+        content = self.state()
+        after_item = self.string_content(content)
+        # wire: entry --"--> content ; entry --]--> exit
+        self.link(entry, _QUOTE, content)
+        self.link(entry, ord("]"), exit_state)
+        # after_item --,--> quote expected --"--> content ; after_item --]--> exit
+        want_quote = self.state()
+        self.link(after_item, ord(","), want_quote)
+        self.link(want_quote, _QUOTE, content)
+        self.link(after_item, ord("]"), exit_state)
+        return exit_state
+
+
+@dataclass
+class PlanGrammar:
+    transitions: np.ndarray  # [n_states, vocab] int32
+    mask: np.ndarray  # [n_states, vocab] bool
+    start_state: int
+    dead_state: int
+    accept_states: frozenset[int]
+    tokenizer: ByteTokenizer
+
+    @property
+    def n_states(self) -> int:
+        return self.transitions.shape[0]
+
+    def is_accept(self, state: int) -> bool:
+        return state in self.accept_states
+
+    def walk(self, text: str) -> int:
+        """Host-side check: run the DFA over ``text`` bytes; returns final
+        state (``dead_state`` on rejection)."""
+        s = self.start_state
+        for b in text.encode("utf-8"):
+            s = int(self.transitions[s, b])
+        return s
+
+
+def build_plan_grammar(tokenizer: ByteTokenizer | None = None) -> PlanGrammar:
+    tok = tokenizer or ByteTokenizer()
+    g = _Builder()
+
+    start = g.state()
+    after_open = g.literal(start, '{"steps":[')
+
+    # --- one item: {"s":"<svc>","in":[...],"next":[...]}
+    item_body = g.state()  # the state just after an item's '{'
+    g.link(after_open, ord("{"), item_body)
+    svc_content_pre = g.literal(item_body, '"s":"')
+    after_svc = g.string_content(svc_content_pre)
+    in_entry = g.literal(after_svc, ',"in":[')
+    after_in = g.string_list(in_entry)
+    next_entry = g.literal(after_in, ',"next":[')
+    after_next = g.string_list(next_entry)
+    item_close = g.literal(after_next, "}")
+
+    # repetition: item_close --,--> expects '{' --> item_body ; --]--> close
+    want_brace = g.state()
+    g.link(item_close, ord(","), want_brace)
+    g.link(want_brace, ord("{"), item_body)
+    steps_closed = g.state()
+    g.link(item_close, ord("]"), steps_closed)
+    accept = g.literal(steps_closed, "}")
+    g.eos_ok.add(accept)
+
+    # --- compile to dense tables
+    n = len(g.transitions) + 1  # + dead state
+    dead = n - 1
+    V = tok.vocab_size
+    trans = np.full((n, V), dead, np.int32)
+    mask = np.zeros((n, V), bool)
+    for s, edges in enumerate(g.transitions):
+        for b, t in edges.items():
+            trans[s, b] = t
+            mask[s, b] = True
+    for s in g.eos_ok:
+        mask[s, tok.eos_id] = True
+        trans[s, tok.eos_id] = dead  # post-EOS state is never consulted
+    # PAD self-loops everywhere (finished sequences feed PAD; mask stays
+    # False so PAD is never *sampled* by a live sequence).
+    trans[:, tok.pad_id] = np.arange(n)
+    return PlanGrammar(
+        transitions=trans,
+        mask=mask,
+        start_state=start,
+        dead_state=dead,
+        accept_states=frozenset(g.eos_ok),
+        tokenizer=tok,
+    )

@@ -70,10 +70,14 @@ class ControlPlane:
             self.metrics.plans.labels(planner=type(self.planner).__name__, status="error").inc()
             raise
         if use_cache and self.config.planner.plan_cache_size > 0:
-            self._plan_cache[key] = plan
-            while len(self._plan_cache) > self.config.planner.plan_cache_size:
-                self._plan_cache.popitem(last=False)
+            self._cache_put(key, plan)
         return plan, (time.monotonic() - t0) * 1e3
+
+    def _cache_put(self, key: tuple[str, int], plan: Plan) -> None:
+        self._plan_cache[key] = plan
+        self._plan_cache.move_to_end(key)
+        while len(self._plan_cache) > self.config.planner.plan_cache_size:
+            self._plan_cache.popitem(last=False)
 
     async def _context(self, intent: str, exclude: Optional[set[str]] = None) -> PlanContext:
         shortlist = None
@@ -117,7 +121,7 @@ class ControlPlane:
         if trace.replans and result.status == "ok" and self.config.planner.plan_cache_size > 0:
             # The repaired plan is the one worth caching; otherwise every
             # request for this intent repeats the fail->replan cycle.
-            self._plan_cache[(intent, await self.registry.version())] = plan
+            self._cache_put((intent, await self.registry.version()), plan)
         return {
             "graph": plan.to_wire(),
             "results": result.results,

@@ -1,0 +1,139 @@
+"""Kernel tests (SURVEY.md §4.2): Pallas paged attention in interpret mode
+vs the pure-jnp reference, plus allocator invariants — property-style over
+ragged page tables and odd shapes."""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mcpx.core.errors import EngineError
+from mcpx.ops import paged_attention, paged_attention_reference
+from mcpx.engine.kv_cache import (
+    PageAllocator,
+    commit_prefill_to_pages,
+    init_paged_kv,
+    write_decode_kv,
+)
+from mcpx.models.gemma.config import GemmaConfig
+
+
+def make_case(key, B, K, G, hd, psz, p_max, n_pages, max_len):
+    """Random q/pages/page_table/seq_lens with ragged lengths."""
+    ks = jax.random.split(key, 4)
+    q = jax.random.normal(ks[0], (B, K, G, hd), jnp.float32)
+    k_pages = jax.random.normal(ks[1], (K, n_pages, psz, hd), jnp.float32)
+    v_pages = jax.random.normal(ks[2], (K, n_pages, psz, hd), jnp.float32)
+    rng = random.Random(int(jax.random.randint(ks[3], (), 0, 2**31 - 1)))
+    seq_lens = [rng.randint(1, max_len) for _ in range(B)]
+    table = np.zeros((B, p_max), np.int32)
+    used = set([0])
+    for b, sl in enumerate(seq_lens):
+        need = -(-sl // psz)
+        for i in range(need):
+            p = rng.choice([x for x in range(1, n_pages) if x not in used])
+            used.add(p)
+            table[b, i] = p
+    return q, k_pages, v_pages, jnp.array(table), jnp.array(seq_lens, jnp.int32)
+
+
+@pytest.mark.parametrize(
+    "B,K,G,hd,psz,maxlen",
+    [
+        (1, 1, 8, 128, 16, 40),  # MQA
+        (3, 2, 2, 128, 16, 50),  # GQA, ragged batch
+        (2, 4, 1, 256, 8, 17),   # MHA-ish, odd lengths
+    ],
+)
+def test_kernel_matches_reference(B, K, G, hd, psz, maxlen):
+    p_max = -(-maxlen // psz) + 1
+    n_pages = B * p_max + 2
+    q, kp, vp, table, lens = make_case(
+        jax.random.PRNGKey(B * 100 + K), B, K, G, hd, psz, p_max, n_pages, maxlen
+    )
+    ref = paged_attention_reference(q, kp, vp, table, lens)
+    out = paged_attention(q, kp, vp, table, lens, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_reference_matches_dense_attention():
+    """The paged reference itself must equal vanilla dense attention."""
+    B, K, G, hd, psz = 1, 1, 4, 64, 4
+    S = 12
+    key = jax.random.PRNGKey(0)
+    q, kp, vp, table, _ = make_case(key, B, K, G, hd, psz, 4, 8, S)
+    lens = jnp.array([S])
+    # Dense K/V from the pages the table points to.
+    k = kp[:, np.asarray(table[0])].reshape(K, -1, hd)[:, :S]
+    v = vp[:, np.asarray(table[0])].reshape(K, -1, hd)[:, :S]
+    logits = jnp.einsum("kgh,ksh->kgs", q[0], k) / np.sqrt(hd)
+    dense = jnp.einsum("kgs,ksh->kgh", jax.nn.softmax(logits, -1), v)
+    ref = paged_attention_reference(q, kp, vp, table, lens)
+    np.testing.assert_allclose(np.asarray(ref[0]), np.asarray(dense), rtol=1e-5, atol=1e-5)
+
+
+def test_commit_and_decode_write_roundtrip():
+    cfg = GemmaConfig(dtype="float32", n_layers=2, n_kv_heads=2, head_dim=16)
+    psz, n_pages, B, T = 4, 16, 2, 8
+    paged = init_paged_kv(cfg, n_pages, psz)
+    dense = {
+        "k": jax.random.normal(jax.random.PRNGKey(1), (2, B, T, 2, 16)),
+        "v": jax.random.normal(jax.random.PRNGKey(2), (2, B, T, 2, 16)),
+    }
+    table = jnp.array([[1, 2, 0, 0], [3, 4, 0, 0]], jnp.int32)
+    seq_lens = jnp.array([T, 5])
+    paged = commit_prefill_to_pages(paged, dense, table, seq_lens, psz)
+    # Page 1 holds seq0 chunk0, page 2 chunk1.
+    np.testing.assert_allclose(
+        np.asarray(paged["k"][0, :, 1]),  # [K, psz, hd]
+        np.asarray(dense["k"][0, 0, :psz].transpose(1, 0, 2)),
+    )
+    np.testing.assert_allclose(
+        np.asarray(paged["k"][1, :, 4]),
+        np.asarray(dense["k"][1, 1, psz:].transpose(1, 0, 2)),
+    )
+    # Decode write at position 5 for seq1 -> page 4 slot 1.
+    k_new = jax.random.normal(jax.random.PRNGKey(3), (2, B, 2, 16))
+    v_new = jax.random.normal(jax.random.PRNGKey(4), (2, B, 2, 16))
+    paged = write_decode_kv(paged, k_new, v_new, table, jnp.array([8 % (psz * 4), 5]))
+    np.testing.assert_allclose(
+        np.asarray(paged["k"][0, :, 4, 1]), np.asarray(k_new[0, 1])
+    )
+
+
+def test_allocator_invariants():
+    a = PageAllocator(n_pages=32, page_size=8, max_pages_per_seq=8)
+    p1 = a.allocate(1, 20)  # 3 pages
+    assert len(p1) == 3
+    p2 = a.allocate(2, 1)
+    assert len(p2) == 1
+    a.check_invariants()
+    grown = a.extend(1, 40)  # 5 pages
+    assert len(grown) == 5
+    a.check_invariants()
+    a.free(1)
+    a.free(1)  # double-free is a no-op
+    a.check_invariants()
+    stats = a.stats()
+    assert stats.sequences == 1
+    assert stats.free_pages == 31 - 1  # only seq 2's single page held
+    with pytest.raises(EngineError, match="already has pages"):
+        a.allocate(2, 4)
+
+
+def test_allocator_exhaustion():
+    a = PageAllocator(n_pages=4, page_size=8, max_pages_per_seq=8)
+    a.allocate(1, 24)  # 3 pages = all available
+    assert not a.can_allocate(1)
+    with pytest.raises(EngineError, match="out of KV pages"):
+        a.allocate(2, 1)
+    a.free(1)
+    assert a.can_allocate(24)
+
+
+def test_allocator_respects_max_pages_per_seq():
+    a = PageAllocator(n_pages=64, page_size=8, max_pages_per_seq=2)
+    with pytest.raises(EngineError, match="max_pages_per_seq"):
+        a.allocate(1, 100)
