@@ -150,6 +150,7 @@ class InferenceEngine:
         self.state = "ready"
 
     async def aclose(self) -> None:
+        self.state = "closed"
         self._stop = True
         self._queue.put(None)
         if self._thread is not None:
@@ -337,14 +338,29 @@ class InferenceEngine:
             # decode loop (constrained flag and temperature are batch-wide);
             # the rest stay pending for the next round.
             head = pending[0]
-            compat = [
-                r
-                for r in pending
-                if r.constrained == head.constrained and r.temperature == head.temperature
-            ][: self.config.engine.max_batch_size]
-            rest = [r for r in pending if r not in compat]
+            compat: list[GenerateRequest] = []
+            rest: list[GenerateRequest] = []
+            for r in pending:
+                if (
+                    len(compat) < self.config.engine.max_batch_size
+                    and r.constrained == head.constrained
+                    and r.temperature == head.temperature
+                ):
+                    compat.append(r)
+                else:
+                    rest.append(r)
             pending = rest
             self._process_batch(compat)
+        # Shutdown: nothing enqueued or deferred may be left hanging.
+        for r in pending:
+            r.loop.call_soon_threadsafe(_resolve, r.future, None, EngineError("engine closed"))
+        while True:
+            try:
+                r = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if r is not None:
+                r.loop.call_soon_threadsafe(_resolve, r.future, None, EngineError("engine closed"))
 
     def _process_batch(self, batch: list[GenerateRequest]) -> None:
         try:
@@ -372,9 +388,17 @@ class InferenceEngine:
                 f"decode budget {steps} exceeds page capacity {capacity} "
                 f"(max_pages_per_seq*kv_page_size)"
             )
-        longest = min(self._prefill_buckets[-1], capacity - steps)
+        # Buckets above the page capacity would scatter more prefill chunks
+        # than the page table has columns.
+        eligible = tuple(b for b in self._prefill_buckets if b <= capacity)
+        if not eligible:
+            raise EngineError(
+                f"no prefill bucket fits page capacity {capacity}; "
+                f"raise max_pages_per_seq or kv_page_size"
+            )
+        longest = min(eligible[-1], capacity - steps)
         max_prompt = min(longest, max(len(r.prompt_ids) for r in batch))
-        T = _bucket(max_prompt, self._prefill_buckets)
+        T = _bucket(max_prompt, eligible)
 
         tokens = np.full((B, T), tok.pad_id, np.int32)
         seq_lens = np.ones((B,), np.int32)
@@ -414,6 +438,8 @@ class InferenceEngine:
             # Pools were donated to prefill: point at the live buffers
             # immediately so an exception below can't leave stale handles.
             self._paged_kv = {"k": k_p, "v": v_p}
+            last_logits.block_until_ready()
+            t_mid = time.monotonic()
             out_buf = jnp.full((B, steps), tok.pad_id, jnp.int32)
             # The worker only batches requests with identical sampling
             # semantics (see _worker), so these are batch-wide by invariant.
@@ -454,8 +480,8 @@ class InferenceEngine:
                     prompt_tokens=len(r.prompt_ids),
                     generated_tokens=len(ids),
                     queue_ms=(t0 - r.enqueued_at) * 1e3,
-                    prefill_ms=(t1 - t0) * 1e3,  # combined below
-                    decode_ms=(t1 - t0) * 1e3,
+                    prefill_ms=(t_mid - t0) * 1e3,
+                    decode_ms=(t1 - t_mid) * 1e3,
                 )
             )
         self.metrics.decode_tokens.inc(gen_total)

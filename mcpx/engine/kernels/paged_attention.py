@@ -73,7 +73,6 @@ def _kernel(
     sem_v,
     *,
     page_size: int,
-    max_pages: int,
 ):
     b = pl.program_id(0)
     kh = pl.program_id(1)
@@ -150,7 +149,6 @@ def paged_attention(
 ) -> jax.Array:
     B, K, G, hd = q.shape
     _, _, page_size, _ = k_pages.shape
-    max_pages = page_table.shape[1]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
@@ -172,7 +170,7 @@ def paged_attention(
             pltpu.SemaphoreType.DMA((2,)),
         ],
     )
-    kernel = functools.partial(_kernel, page_size=page_size, max_pages=max_pages)
+    kernel = functools.partial(_kernel, page_size=page_size)
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,

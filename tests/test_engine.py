@@ -137,3 +137,31 @@ def test_per_request_budget_and_mixed_sampling():
             await eng.aclose()
 
     asyncio.run(go())
+
+
+def test_prefill_bucket_clamped_to_page_capacity():
+    # capacity = 6*16 = 96; a 70-token prompt must not round up to the
+    # T=128 bucket (which would scatter 8 chunks into 6 page columns).
+    async def go():
+        eng = make_engine(max_pages_per_seq=6, max_decode_len=16)
+        await eng.start()
+        try:
+            prompt = list(range(3, 73))  # 70 tokens
+            res = await eng.generate(prompt, max_new_tokens=16)
+            assert res.generated_tokens > 0
+        finally:
+            await eng.aclose()
+
+    asyncio.run(go())
+
+
+def test_generate_after_close_and_shutdown_drain():
+    async def go():
+        eng = make_engine()
+        await eng.start()
+        await eng.aclose()
+        assert eng.state == "closed"
+        with pytest.raises(EngineError):
+            await eng.generate([1, 2, 3], max_new_tokens=4)
+
+    asyncio.run(go())
