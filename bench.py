@@ -1,0 +1,178 @@
+#!/usr/bin/env python
+"""North-star benchmark: plans/sec through the real serving stack.
+
+Measures `POST /plan` end-to-end — aiohttp server, retrieval shortlist over a
+1,000-service registry, prompt build, grammar-constrained batched decode on
+the inference engine, validation/repair — and prints ONE JSON line:
+
+    {"metric": "plans_per_sec", "value": N, "unit": "plans/s", "vs_baseline": N/100}
+
+vs_baseline is against the north-star target of 100 plans/sec (BASELINE.md;
+the reference publishes no numbers of its own, SURVEY.md §6).
+
+Environment knobs:
+    MCPX_BENCH_MODEL     model size ("2b" default on TPU, "test" on CPU)
+    MCPX_BENCH_REQUESTS  total /plan requests (default 512)
+    MCPX_BENCH_CONCURRENCY  in-flight requests (default 256)
+    MCPX_BENCH_SERVICES  registry size (default 1000)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import statistics
+import sys
+import time
+
+
+def _build_config(model_size: str):
+    from mcpx.core.config import MCPXConfig
+
+    return MCPXConfig.from_dict(
+        {
+            "model": {"size": model_size, "max_seq_len": 2048},
+            "engine": {
+                "max_batch_size": 32,
+                "max_decode_len": 96,
+                "kv_page_size": 16,
+                "max_pages_per_seq": 128,
+                "temperature": 0.0,
+                "use_pallas": True,
+                # Pallas kernels need a real TPU; interpret mode on CPU.
+                "interpret": False,
+            },
+            "planner": {
+                "kind": "llm",
+                # One constrained decode per plan; validation failures repair
+                # via the heuristic (worst-case cost path for random weights).
+                "max_plan_retries": 0,
+                "shortlist_top_k": 8,
+            },
+        }
+    )
+
+
+async def _run(model_size: str, n_requests: int, concurrency: int, n_services: int) -> dict:
+    from aiohttp import ClientSession, TCPConnector
+    from aiohttp.test_utils import TestServer
+
+    from mcpx.server.app import build_app
+    from mcpx.server.factory import build_control_plane
+    from mcpx.utils.synth import synth_registry
+
+    import random
+
+    cfg = _build_config(model_size)
+    if not _on_tpu():
+        cfg.engine.use_pallas = False
+    cp = build_control_plane(cfg)
+    for rec in synth_registry(n_services, seed=7):
+        await cp.registry.put(rec)
+
+    app = build_app(cp)
+    server = TestServer(app)
+    await server.start_server()
+    base = f"http://{server.host}:{server.port}"
+
+    rng = random.Random(11)
+    from mcpx.utils.synth import intent_for
+
+    records = await cp.registry.list_services()
+    intents = [f"{intent_for(records, rng)} [{i}]" for i in range(n_requests)]
+
+    t_setup0 = time.monotonic()
+    async with ClientSession(connector=TCPConnector(limit=concurrency)) as session:
+        # Warmup: trigger engine startup + compile for the hot batch buckets.
+        async def warm_one(w: str) -> int:
+            async with session.post(f"{base}/plan", json={"intent": w}) as resp:
+                await resp.read()
+                return resp.status
+
+        warm = [f"warmup intent {i}" for i in range(cfg.engine.max_batch_size)]
+        statuses = await asyncio.gather(*(warm_one(w) for w in warm))
+        bad = [s for s in statuses if s != 200]
+        if bad:
+            raise RuntimeError(f"warmup failed: {len(bad)}/{len(warm)} non-200 responses")
+        warmup_s = time.monotonic() - t_setup0
+
+        latencies: list[float] = []
+        sem = asyncio.Semaphore(concurrency)
+        errors = 0
+
+        async def one(intent: str) -> None:
+            nonlocal errors
+            async with sem:
+                t0 = time.monotonic()
+                async with session.post(f"{base}/plan", json={"intent": intent}) as resp:
+                    await resp.read()
+                    if resp.status != 200:
+                        errors += 1
+                latencies.append((time.monotonic() - t0) * 1e3)
+
+        t0 = time.monotonic()
+        await asyncio.gather(*(one(i) for i in intents))
+        elapsed = time.monotonic() - t0
+
+    await server.close()
+    engine = getattr(cp.planner, "engine", None)
+    if engine is not None and engine.state == "ready":
+        await engine.aclose()
+
+    if errors > max(1, n_requests // 100):
+        raise RuntimeError(f"{errors}/{n_requests} requests failed")
+    lat = sorted(latencies)
+    return {
+        "plans_per_sec": n_requests / elapsed,
+        "p50_ms": statistics.median(lat),
+        "p99_ms": lat[int(0.99 * (len(lat) - 1))],
+        "elapsed_s": elapsed,
+        "warmup_s": warmup_s,
+        "errors": errors,
+    }
+
+
+def _on_tpu() -> bool:
+    import jax
+
+    return jax.default_backend() not in ("cpu",)
+
+
+def main() -> None:
+    model = os.environ.get("MCPX_BENCH_MODEL")
+    n_requests = int(os.environ.get("MCPX_BENCH_REQUESTS", "512"))
+    concurrency = int(os.environ.get("MCPX_BENCH_CONCURRENCY", "256"))
+    n_services = int(os.environ.get("MCPX_BENCH_SERVICES", "1000"))
+    if model is None:
+        model = "2b" if _on_tpu() else "test"
+
+    try:
+        stats = asyncio.run(_run(model, n_requests, concurrency, n_services))
+    except Exception as e:  # noqa: BLE001 - one fallback tier, then report
+        print(f"bench: model={model} failed ({type(e).__name__}: {e}); retrying size=test",
+              file=sys.stderr)
+        model = "test"
+        stats = asyncio.run(_run(model, n_requests, concurrency, n_services))
+
+    value = round(stats["plans_per_sec"], 2)
+    print(
+        json.dumps(
+            {
+                "metric": "plans_per_sec",
+                "value": value,
+                "unit": "plans/s",
+                "vs_baseline": round(value / 100.0, 3),
+                "p50_ms": round(stats["p50_ms"], 1),
+                "p99_ms": round(stats["p99_ms"], 1),
+                "model": model,
+                "n_services": n_services,
+                "requests": n_requests,
+                "errors": stats["errors"],
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

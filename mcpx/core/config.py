@@ -69,10 +69,13 @@ class EngineConfig:
 
 @dataclass
 class RetrievalConfig:
+    enabled: bool = True
     embed_dim: int = 256
     top_k: int = 8
     # Refresh the HBM table when the registry version changes.
     auto_refresh: bool = True
+    # Optional .npz snapshot to load at startup (rebuildable from registry).
+    snapshot_path: str = ""
 
 
 @dataclass

@@ -185,6 +185,12 @@ class InferenceEngine:
         from mcpx.parallel.mesh import make_mesh
 
         ecfg = self.config.engine
+        # Mosaic tiles the last (lane) dim at 128: head dims that don't align
+        # can't use the Pallas kernel on hardware — fall back to the fused-jnp
+        # paged attention (interpret mode has no such constraint).
+        self._use_pallas = ecfg.use_pallas and (
+            ecfg.interpret or self.model_cfg.head_dim % 128 == 0
+        )
         if self._mesh is None:
             n = len(jax.devices())
             model_axis = min(ecfg.model_axis, n)
@@ -268,7 +274,7 @@ class InferenceEngine:
                 pos,
                 page_table,
                 {"k": k_p, "v": v_p},
-                use_pallas=self.config.engine.use_pallas,
+                use_pallas=self._use_pallas,
                 interpret=self.config.engine.interpret,
             )
             key, sub = jax.random.split(key)

@@ -1,0 +1,172 @@
+"""LLM planner: intent → grammar-constrained on-device decode → validated Plan.
+
+North-star replacement for the reference's OpenAI round-trip (reference
+``control_plane.py:57-75``). Differences that are the point:
+
+  - the "LLM call" is the in-tree ``InferenceEngine`` — batched, paged
+    TPU decode; concurrent intents coalesce into shared decode loops (the
+    reference blocks the event loop per request, bug B6);
+  - output is **grammar-constrained** at the token level (DFA mask inside
+    the jitted decode loop), so the raw ``json.loads``-crashes-on-prose
+    failure mode (bug B7) is impossible by construction;
+  - the prompt is built from the retrieval *shortlist* + live telemetry
+    features, not the whole registry (bug B9);
+  - node endpoints are resolved from the registry by the control plane —
+    never trusted from model output (SURVEY.md §2.4 build decision);
+  - validation failures cost a bounded number of re-decodes, then fall back
+    to the deterministic ``HeuristicPlanner`` — planning always returns a
+    valid plan or raises ``PlannerError``, never a malformed one.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+from mcpx.core.config import MCPXConfig, PlannerConfig
+from mcpx.core.dag import Plan, PlanValidationError
+from mcpx.core.errors import PlannerError
+from mcpx.engine.engine import InferenceEngine
+from mcpx.planner.base import PlanContext
+from mcpx.planner.heuristic import HeuristicPlanner
+from mcpx.registry.base import ServiceRecord
+
+log = logging.getLogger("mcpx.planner.llm")
+
+
+class LLMPlanner:
+    def __init__(
+        self,
+        engine: InferenceEngine,
+        config: Optional[PlannerConfig] = None,
+        *,
+        fallback: Optional[HeuristicPlanner] = None,
+    ) -> None:
+        self.engine = engine
+        self.config = config or PlannerConfig()
+        self.fallback = fallback or HeuristicPlanner(self.config)
+        self._start_lock = asyncio.Lock()
+
+    @classmethod
+    def from_config(cls, config: MCPXConfig, retriever=None) -> "LLMPlanner":
+        return cls(InferenceEngine(config), config.planner)
+
+    # -------------------------------------------------------------- lifecycle
+    async def ensure_ready(self) -> None:
+        if self.engine.state == "ready":
+            return
+        async with self._start_lock:
+            if self.engine.state == "cold":
+                await self.engine.start()
+        if self.engine.state != "ready":
+            raise PlannerError(f"inference engine unavailable (state={self.engine.state})")
+
+    # ------------------------------------------------------------------ plan
+    async def plan(self, intent: str, context: PlanContext) -> Plan:
+        await self.ensure_ready()
+        services = await self._candidates(context)
+        if not services:
+            raise PlannerError("registry is empty; nothing to plan with")
+        by_name = {s.name: s for s in services}
+        prompt = self._prompt(intent, services, context)
+        prompt_ids = self.engine.tokenizer.encode(prompt)
+
+        last_problems: list[str] = []
+        for attempt in range(self.config.max_plan_retries + 1):
+            res = await self.engine.generate(prompt_ids, constrained=True)
+            try:
+                plan = Plan.from_json(res.text)
+            except PlanValidationError as e:
+                last_problems = e.problems
+                log.info("plan attempt %d rejected: %s", attempt, e.problems[:3])
+                continue
+            unknown = [n.service for n in plan.nodes if n.service not in by_name]
+            if unknown:
+                last_problems = [f"unknown service(s): {unknown}"]
+                log.info("plan attempt %d names unknown services %s", attempt, unknown)
+                continue
+            self._resolve(plan, by_name)
+            plan.intent = intent
+            if self.config.explain:
+                plan.explanation = self._explain(plan, attempt)
+            return plan
+
+        log.warning(
+            "LLM planner exhausted %d attempts (%s); falling back to heuristic",
+            self.config.max_plan_retries + 1,
+            last_problems[:3],
+        )
+        plan = await self.fallback.plan(intent, context)
+        if self.config.explain:
+            plan.explanation = (
+                f"[heuristic fallback after {self.config.max_plan_retries + 1} "
+                f"constrained-decode attempts] " + plan.explanation
+            )
+        return plan
+
+    # -------------------------------------------------------------- internals
+    async def _candidates(self, context: PlanContext) -> list[ServiceRecord]:
+        services = await context.registry.list_services()
+        if context.exclude:
+            services = [s for s in services if s.name not in context.exclude]
+        if context.shortlist:
+            order = {name: i for i, name in enumerate(context.shortlist)}
+            short = sorted(
+                (s for s in services if s.name in order), key=lambda s: order[s.name]
+            )
+            if short:
+                return short
+        return services
+
+    def _prompt(self, intent: str, services: list[ServiceRecord], context: PlanContext) -> str:
+        """Compact prompt: shortlist + telemetry features + intent, trimmed to
+        ``max_prompt_tokens`` (byte tokenizer: 1 token ≈ 1 char)."""
+        lines = [
+            "Compose microservices into a DAG for the intent.",
+            'Reply with JSON {"steps":[{"s":svc,"in":[keys],"next":[svcs]}]}.',
+            "Services:",
+        ]
+        for s in services:
+            feat = ""
+            st = context.telemetry.get(s.name)
+            if st is not None:
+                feat = f" err={st.ewma_error_rate:.2f} p50={st.ewma_latency_ms:.0f}ms"
+            cost = s.cost_profile.get("cost")
+            if cost is not None:
+                feat += f" cost={cost:g}"
+            lines.append(f"- {s.schema_text()}{feat}")
+        lines.append(f"Intent: {intent}")
+        lines.append("JSON:")
+        text = "\n".join(lines)
+        budget = self.config.max_prompt_tokens
+        if len(text) > budget:
+            # Drop whole service lines from the tail of the list (lowest
+            # retrieval rank) until the prompt fits; intent always survives.
+            head, tail = lines[:3], lines[3:-2]
+            fixed = len("\n".join(head)) + len("\n".join(lines[-2:])) + 2
+            kept: list[str] = []
+            for line in tail:
+                if fixed + len(line) + 1 > budget:
+                    break
+                kept.append(line)
+                fixed += len(line) + 1
+            text = "\n".join(head + kept + lines[-2:])
+        return text
+
+    def _resolve(self, plan: Plan, by_name: dict[str, ServiceRecord]) -> None:
+        """Fill endpoints/fallbacks/costs from the registry (LLM output is
+        never trusted for routing, SURVEY.md §2.4)."""
+        for node in plan.nodes:
+            rec = by_name[node.service]
+            node.endpoint = rec.endpoint
+            if not node.fallbacks:
+                node.fallbacks = list(rec.fallbacks)
+
+    def _explain(self, plan: Plan, attempt: int) -> str:
+        gens = plan.topological_generations()
+        stages = " -> ".join("[" + ", ".join(g) + "]" for g in gens)
+        return (
+            f"LLM-planned DAG ({len(plan.nodes)} node(s), decode attempt "
+            f"{attempt + 1}); stages: {stages}"
+        )

@@ -1,0 +1,4 @@
+from mcpx.retrieval.embed import HashedNGramEmbedder
+from mcpx.retrieval.index import RetrievalIndex
+
+__all__ = ["HashedNGramEmbedder", "RetrievalIndex"]

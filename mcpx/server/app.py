@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import logging
 import time
 from typing import Any
 
@@ -30,6 +31,8 @@ from mcpx.core.dag import Plan, PlanValidationError
 from mcpx.core.errors import PlannerError, RegistryError
 from mcpx.registry.base import ServiceRecord
 from mcpx.server.control import ControlPlane
+
+log = logging.getLogger("mcpx.server")
 
 
 def _json_error(status: int, message: str, **extra: Any) -> web.Response:
@@ -96,6 +99,14 @@ def build_app(cp: ControlPlane) -> web.Application:
                 return web.json_response(
                     {"error": f"request exceeded {server_cfg.request_timeout_s}s"},
                     status=504,
+                )
+            except web.HTTPException:
+                raise
+            except Exception as e:  # noqa: BLE001 - errors must be JSON, never HTML
+                status = "error"
+                log.exception("unhandled error on %s", endpoint)
+                return web.json_response(
+                    {"error": f"{type(e).__name__}: {e}"}, status=500
                 )
             finally:
                 if limited:
@@ -218,6 +229,9 @@ def build_app(cp: ControlPlane) -> web.Application:
 
     async def on_cleanup(app: web.Application) -> None:
         await cp.orchestrator.aclose()
+        engine = getattr(cp.planner, "engine", None)
+        if engine is not None and engine.state in ("ready", "warming"):
+            await engine.aclose()
 
     app.on_cleanup.append(on_cleanup)
     return app

@@ -62,7 +62,7 @@ class ControlPlane:
                 return cached, (time.monotonic() - t0) * 1e3
             self.metrics.plan_cache.labels(result="miss").inc()
 
-        context = await self._context(intent)
+        context = await self._context(intent, version=version)
         try:
             plan = await self.planner.plan(intent, context)
             self.metrics.plans.labels(planner=type(self.planner).__name__, status="ok").inc()
@@ -79,15 +79,28 @@ class ControlPlane:
         while len(self._plan_cache) > self.config.planner.plan_cache_size:
             self._plan_cache.popitem(last=False)
 
-    async def _context(self, intent: str, exclude: Optional[set[str]] = None) -> PlanContext:
+    async def _context(
+        self,
+        intent: str,
+        exclude: Optional[set[str]] = None,
+        version: Optional[int] = None,
+    ) -> PlanContext:
         shortlist = None
+        exclude = exclude or set()
         if self.retriever is not None:
-            shortlist = await self.retriever.shortlist(intent, self.config.planner.shortlist_top_k)
+            refresh = getattr(self.retriever, "maybe_refresh", None)
+            if refresh is not None:
+                await refresh(self.registry, version)
+            # Over-fetch so excluded (replanned-around) services don't starve
+            # the shortlist of viable candidates.
+            k = self.config.planner.shortlist_top_k
+            names = await self.retriever.shortlist(intent, k + len(exclude))
+            shortlist = [n for n in names if n not in exclude][:k]
         return PlanContext(
             registry=self.registry,
             telemetry=self.telemetry.snapshot(),
             shortlist=shortlist,
-            exclude=exclude or set(),
+            exclude=exclude,
         )
 
     # --------------------------------------------------------------- execute

@@ -6,6 +6,7 @@ TPU at import time (the reference connects to Postgres at import, bug B8).
 
 from __future__ import annotations
 
+import logging
 from typing import Optional
 
 from mcpx.core.config import MCPXConfig
@@ -34,6 +35,25 @@ def build_control_plane(
     config.validate()
     registry = registry if registry is not None else make_registry(config.registry)
     transport = transport if transport is not None else RouterTransport()
+    if retriever is None and config.retrieval.enabled:
+        try:
+            from mcpx.retrieval import RetrievalIndex  # deferred: pulls in JAX
+        except ImportError as e:
+            logging.getLogger("mcpx.factory").warning(
+                "retrieval disabled: JAX stack unavailable (%s)", e
+            )
+            RetrievalIndex = None
+        if RetrievalIndex is not None:
+            retriever = RetrievalIndex(config.retrieval)
+            if config.retrieval.snapshot_path:
+                try:
+                    retriever.load(config.retrieval.snapshot_path)
+                except Exception as e:  # noqa: BLE001 - snapshot is rebuildable
+                    logging.getLogger("mcpx.factory").warning(
+                        "retrieval snapshot %s unusable (%s); will rebuild from registry",
+                        config.retrieval.snapshot_path,
+                        e,
+                    )
     telemetry = TelemetryStore(config.telemetry.ewma_alpha)
     metrics = Metrics()
     orchestrator = Orchestrator(

@@ -1,0 +1,183 @@
+"""LLMPlanner: prompt construction, endpoint resolution, retry/fallback
+(SURVEY.md §7 step 6; fixes reference bugs B6/B7/B9)."""
+
+import asyncio
+
+import pytest
+
+from mcpx.core.config import MCPXConfig, PlannerConfig
+from mcpx.models.tokenizer import ByteTokenizer
+from mcpx.planner.base import PlanContext
+from mcpx.planner.llm import LLMPlanner
+from mcpx.registry.base import ServiceRecord
+from mcpx.registry.memory import InMemoryRegistry
+from mcpx.telemetry.stats import ServiceStats
+
+
+class FakeEngine:
+    """Duck-typed engine returning scripted completions."""
+
+    def __init__(self, outputs):
+        self.outputs = list(outputs)
+        self.tokenizer = ByteTokenizer()
+        self.state = "ready"
+        self.prompts = []
+
+    async def start(self):
+        self.state = "ready"
+
+    async def generate(self, prompt_ids, **kw):
+        import dataclasses
+
+        self.prompts.append(self.tokenizer.decode(prompt_ids))
+
+        @dataclasses.dataclass
+        class R:
+            text: str
+
+        return R(text=self.outputs.pop(0) if self.outputs else "")
+
+
+async def _registry():
+    reg = InMemoryRegistry()
+    await reg.put(
+        ServiceRecord(
+            name="fetch",
+            endpoint="http://svc/fetch",
+            description="fetch data",
+            output_schema={"data": "str"},
+            fallbacks=["http://backup/fetch"],
+        )
+    )
+    await reg.put(
+        ServiceRecord(
+            name="summarize",
+            endpoint="http://svc/sum",
+            description="summarize text",
+            input_schema={"data": "str"},
+            cost_profile={"cost": 2.0},
+        )
+    )
+    return reg
+
+
+GOOD = '{"steps":[{"s":"fetch","in":[],"next":["summarize"]},{"s":"summarize","in":["data"],"next":[]}]}'
+
+
+def test_valid_completion_resolves_endpoints_from_registry():
+    async def go():
+        reg = await _registry()
+        eng = FakeEngine([GOOD])
+        p = LLMPlanner(eng, PlannerConfig(kind="llm"))
+        plan = await p.plan("fetch and summarize", PlanContext(registry=reg))
+        assert [n.name for n in plan.nodes] == ["fetch", "summarize"]
+        # Endpoints come from the registry, never from model output.
+        assert plan.node("fetch").endpoint == "http://svc/fetch"
+        assert plan.node("fetch").fallbacks == ["http://backup/fetch"]
+        assert plan.node("summarize").endpoint == "http://svc/sum"
+        assert len(plan.edges) == 1 and plan.edges[0].src == "fetch"
+        assert "LLM-planned" in plan.explanation
+
+    asyncio.run(go())
+
+
+def test_unknown_service_retries_then_falls_back_to_heuristic():
+    async def go():
+        reg = await _registry()
+        bad = '{"steps":[{"s":"nonexistent","in":[],"next":[]}]}'
+        eng = FakeEngine([bad, bad, bad])
+        p = LLMPlanner(eng, PlannerConfig(kind="llm", max_plan_retries=2))
+        plan = await p.plan("summarize the data", PlanContext(registry=reg))
+        assert len(eng.prompts) == 3  # exhausted retry budget
+        assert plan.nodes  # heuristic fallback produced something real
+        assert all(n.service in ("fetch", "summarize") for n in plan.nodes)
+        assert "heuristic fallback" in plan.explanation
+
+    asyncio.run(go())
+
+
+def test_second_attempt_can_succeed():
+    async def go():
+        reg = await _registry()
+        eng = FakeEngine(['{"steps":[{"s":"ghost","in":[],"next":[]}]}', GOOD])
+        p = LLMPlanner(eng, PlannerConfig(kind="llm", max_plan_retries=2))
+        plan = await p.plan("x", PlanContext(registry=reg))
+        assert [n.name for n in plan.nodes] == ["fetch", "summarize"]
+        assert "attempt 2" in plan.explanation
+
+    asyncio.run(go())
+
+
+def test_prompt_contains_telemetry_and_respects_shortlist_and_budget():
+    async def go():
+        reg = await _registry()
+        for i in range(40):
+            await reg.put(
+                ServiceRecord(name=f"f{i}", endpoint=f"http://x/{i}", description="y" * 40)
+            )
+        eng = FakeEngine([GOOD])
+        p = LLMPlanner(eng, PlannerConfig(kind="llm", max_prompt_tokens=600))
+        ctx = PlanContext(
+            registry=reg,
+            telemetry={"fetch": ServiceStats("fetch", ewma_latency_ms=12.5, ewma_error_rate=0.25)},
+            shortlist=["summarize", "fetch"],
+        )
+        await p.plan("fetch and summarize", ctx)
+        prompt = eng.prompts[0]
+        assert len(prompt) <= 600
+        assert "err=0.25" in prompt
+        assert "p50=12ms" in prompt or "p50=13ms" in prompt
+        assert "cost=2" in prompt
+        # Shortlisted services only, in retrieval order.
+        assert "summarize" in prompt and "- f3" not in prompt
+        assert prompt.index("summarize |") < prompt.index("fetch |")
+        assert prompt.rstrip().endswith("JSON:")
+        assert "fetch and summarize" in prompt
+
+    asyncio.run(go())
+
+
+def test_exclude_removes_candidates():
+    async def go():
+        reg = await _registry()
+        eng = FakeEngine([GOOD, GOOD])
+        p = LLMPlanner(eng, PlannerConfig(kind="llm", max_plan_retries=0))
+        ctx = PlanContext(registry=reg, exclude={"fetch"})
+        # GOOD names "fetch", which is excluded -> unknown -> heuristic fallback.
+        plan = await p.plan("summarize", ctx)
+        assert all(n.service != "fetch" for n in plan.nodes)
+
+    asyncio.run(go())
+
+
+def test_model_in_the_loop_constrained_decode_falls_back_cleanly():
+    """Real engine, random weights: constrained decode yields grammar-valid
+    JSON whose service names are garbage -> planner must land on the
+    heuristic fallback without ever raising a parse error (bug B7 fixed)."""
+    from mcpx.engine.engine import InferenceEngine
+
+    async def go():
+        cfg = MCPXConfig.from_dict(
+            {
+                "model": {"size": "test", "max_seq_len": 256},
+                "engine": {
+                    "use_pallas": False,
+                    "max_batch_size": 2,
+                    "max_decode_len": 64,
+                    "max_pages_per_seq": 16,
+                    "temperature": 0.0,
+                },
+                "planner": {"kind": "llm", "max_plan_retries": 1},
+            }
+        )
+        eng = InferenceEngine(cfg)
+        p = LLMPlanner(eng, cfg.planner)
+        try:
+            reg = await _registry()
+            plan = await p.plan("fetch then summarize", PlanContext(registry=reg))
+            assert plan.nodes
+            plan.validate()
+        finally:
+            await eng.aclose()
+
+    asyncio.run(go())

@@ -1,0 +1,138 @@
+"""Retrieval: hashed embedder + HBM table + on-device top-k (SURVEY.md §7
+step 5; replaces the reference's dead pgvector, control_plane.py:46-55)."""
+
+import asyncio
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding
+
+from mcpx.core.config import RetrievalConfig
+from mcpx.parallel.mesh import make_mesh
+from mcpx.registry.base import ServiceRecord
+from mcpx.registry.memory import InMemoryRegistry
+from mcpx.retrieval import HashedNGramEmbedder, RetrievalIndex
+
+
+def _record(name, desc, **kw):
+    return ServiceRecord(name=name, endpoint=f"local://{name}", description=desc, **kw)
+
+
+async def _registry(n_extra=0):
+    reg = InMemoryRegistry()
+    await reg.put(_record("currency", "convert currency exchange rates",
+                          input_schema={"amount": "float", "from": "str", "to": "str"}))
+    await reg.put(_record("weather", "current weather forecast by city",
+                          input_schema={"city": "str"}))
+    await reg.put(_record("sentiment", "sentiment analysis of text",
+                          input_schema={"text": "str"}))
+    for i in range(n_extra):
+        await reg.put(_record(f"filler{i}", f"unrelated placeholder service {i}"))
+    return reg
+
+
+def test_embedder_deterministic_and_discriminative():
+    e = HashedNGramEmbedder(256)
+    a1, a2 = e.embed("convert currency rates"), e.embed("convert currency rates")
+    np.testing.assert_array_equal(a1, a2)
+    assert abs(float(np.linalg.norm(a1)) - 1.0) < 1e-5
+    sim_close = float(a1 @ e.embed("currency conversion exchange"))
+    sim_far = float(a1 @ e.embed("weather forecast tomorrow"))
+    assert sim_close > sim_far
+    assert np.all(e.embed("") == 0)
+
+
+def test_shortlist_ranks_relevant_service_first():
+    async def go():
+        reg = await _registry(n_extra=20)
+        idx = RetrievalIndex(RetrievalConfig(embed_dim=256))
+        await idx.refresh(reg)
+        assert idx.size == 23
+        names = await idx.shortlist("convert 100 dollars to euro exchange rate", 3)
+        assert names[0] == "currency"
+        names = await idx.shortlist("what is the weather in berlin", 3)
+        assert names[0] == "weather"
+
+    asyncio.run(go())
+
+
+def test_refresh_only_on_version_change():
+    async def go():
+        reg = await _registry()
+        idx = RetrievalIndex()
+        assert await idx.refresh(reg) is True
+        assert await idx.refresh(reg) is False  # same version: no rebuild
+        await reg.put(_record("new", "brand new translation service"))
+        assert await idx.refresh(reg) is True
+        assert "new" in await idx.shortlist("translation service", 4)
+
+    asyncio.run(go())
+
+
+def test_empty_registry_and_k_clamp():
+    async def go():
+        reg = InMemoryRegistry()
+        idx = RetrievalIndex()
+        await idx.refresh(reg)
+        assert await idx.shortlist("anything", 5) == []
+        reg2 = await _registry()
+        await idx.refresh(reg2)
+        assert len(await idx.shortlist("anything", 99)) == 3
+
+    asyncio.run(go())
+
+
+def test_sharded_table_matches_single_device():
+    async def go():
+        reg = await _registry(n_extra=21)  # 24 rows: divisible by model axis 4
+        plain = RetrievalIndex()
+        await plain.refresh(reg)
+        mesh = make_mesh(data=2, model=4)
+        sharded = RetrievalIndex(mesh=mesh)
+        await sharded.refresh(reg)
+        assert isinstance(sharded._table.sharding, NamedSharding)
+        q = "analyse the sentiment of customer reviews"
+        # Tied filler scores may order differently across shardings; the
+        # score *vectors* must match and the clear winner must agree.
+        qv = jax.numpy.asarray(plain.embedder.embed(q))
+        np.testing.assert_allclose(
+            np.asarray(plain._table @ qv), np.asarray(sharded._table @ qv), atol=1e-6
+        )
+        assert (await plain.shortlist(q, 5))[0] == (await sharded.shortlist(q, 5))[0] == "sentiment"
+
+    asyncio.run(go())
+
+
+def test_snapshot_roundtrip(tmp_path):
+    async def go():
+        reg = await _registry()
+        idx = RetrievalIndex()
+        await idx.refresh(reg)
+        path = str(tmp_path / "emb.npz")
+        idx.save(path)
+        fresh = RetrievalIndex()
+        fresh.load(path)
+        assert fresh.size == idx.size
+        assert fresh.version == -1  # provisional until revalidated vs live registry
+        assert await fresh.shortlist("weather in paris", 2) == await idx.shortlist(
+            "weather in paris", 2
+        )
+
+    asyncio.run(go())
+
+
+def test_control_plane_uses_shortlist():
+    from mcpx.core.config import MCPXConfig
+    from mcpx.server.factory import build_control_plane
+
+    async def go():
+        cfg = MCPXConfig.from_dict({"planner": {"kind": "heuristic", "shortlist_top_k": 2}})
+        cp = build_control_plane(cfg)
+        reg = cp.registry
+        for r in await (await _registry(n_extra=10)).list_services():
+            await reg.put(r)
+        plan, _ = await cp.plan("convert currency to euros")
+        assert any(n.service == "currency" for n in plan.nodes)
+
+    asyncio.run(go())
