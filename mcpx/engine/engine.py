@@ -133,6 +133,13 @@ class InferenceEngine:
         # DFA tables on device.
         self._dfa_trans = jnp.asarray(self.grammar.transitions)
         self._dfa_mask = jnp.asarray(self.grammar.mask)
+        # dist[s] = fewest samples (incl. EOS) to an accepted output from s;
+        # masking tokens whose successor can't finish in the remaining budget
+        # guarantees constrained decodes are never truncated mid-JSON.
+        self._dfa_dist = jnp.asarray(self.grammar.dist)
+        self._eos_onehot = jnp.zeros((self.grammar.mask.shape[1],), bool).at[
+            self.tokenizer.eos_id
+        ].set(True)
 
     # ------------------------------------------------------------- lifecycle
     async def start(self) -> None:
@@ -249,11 +256,24 @@ class InferenceEngine:
         cfg = self.model_cfg
         tok = self.tokenizer
         B = seq_lens.shape[0]
-        trans, mask_tab = self._dfa_trans, self._dfa_mask
+        trans, mask_tab, dist = self._dfa_trans, self._dfa_mask, self._dfa_dist
+        eos_1h = self._eos_onehot
         start_state = jnp.full((B,), self.grammar.start_state, jnp.int32)
 
+        def budget_mask(st, rem):
+            # Allow token t iff grammar-legal AND (t is EOS or the successor
+            # state can still finish within the remaining sample budget) —
+            # this forces the JSON closed before the budget runs out. When the
+            # budget can't fit any completion at all (caller asked for fewer
+            # tokens than the shortest valid plan), degrade to the plain
+            # grammar mask: the output is then a legal prefix, never garbage.
+            legal = mask_tab[st]
+            finishable = legal & (eos_1h[None, :] | (dist[trans[st]] <= rem[:, None]))
+            feasible = jnp.any(finishable, axis=-1, keepdims=True)
+            return jnp.where(feasible, finishable, legal)
+
         key, sub = jax.random.split(key)
-        mask0 = mask_tab[start_state] if constrained else None
+        mask0 = budget_mask(start_state, budgets - 1) if constrained else None
         first = sample(first_logits, sub, temperature=temperature, top_k=self.config.engine.top_k, mask=mask0)
         first = first.astype(jnp.int32)
         done0 = (first == tok.eos_id) | ~active | (budgets < 1)
@@ -278,7 +298,9 @@ class InferenceEngine:
                 interpret=self.config.engine.interpret,
             )
             key, sub = jax.random.split(key)
-            mask = mask_tab[st] if constrained else None
+            # This sample is emission i+2 (the pre-loop token was emission 1),
+            # so budgets-(i+2) samples remain after it.
+            mask = budget_mask(st, budgets - (i + 2)) if constrained else None
             nxt = sample(
                 logits, sub, temperature=temperature, top_k=self.config.engine.top_k, mask=mask
             ).astype(jnp.int32)
@@ -383,6 +405,8 @@ class InferenceEngine:
         t_start = time.monotonic()
         B_real = len(batch)
         B = _bucket(B_real, self._batch_buckets)
+        # Batch-wide by worker invariant (see _worker's compat split).
+        constrained = batch[0].constrained
         max_new = max(r.max_new_tokens for r in batch)
         steps = min(max_new, ecfg.max_decode_len)
         # Prompts are trimmed to their tail (most recent context) so they fit
@@ -447,9 +471,7 @@ class InferenceEngine:
             last_logits.block_until_ready()
             t_mid = time.monotonic()
             out_buf = jnp.full((B, steps), tok.pad_id, jnp.int32)
-            # The worker only batches requests with identical sampling
-            # semantics (see _worker), so these are batch-wide by invariant.
-            constrained = batch[0].constrained
+            # Batch-wide by worker invariant (see _worker's compat split).
             temperature = batch[0].temperature
             buf, st, done, k_p, v_p = self._jit_decode(
                 self._params,

@@ -99,6 +99,7 @@ class _Builder:
 class PlanGrammar:
     transitions: np.ndarray  # [n_states, vocab] int32
     mask: np.ndarray  # [n_states, vocab] bool
+    dist: np.ndarray  # [n_states] int32 — min samples (incl. EOS) to finish
     start_state: int
     dead_state: int
     accept_states: frozenset[int]
@@ -107,6 +108,11 @@ class PlanGrammar:
     @property
     def n_states(self) -> int:
         return self.transitions.shape[0]
+
+    @property
+    def min_len(self) -> int:
+        """Fewest sampled tokens (including EOS) of any accepted output."""
+        return int(self.dist[self.start_state])
 
     def is_accept(self, state: int) -> bool:
         return state in self.accept_states
@@ -166,8 +172,52 @@ def build_plan_grammar(tokenizer: ByteTokenizer | None = None) -> PlanGrammar:
     return PlanGrammar(
         transitions=trans,
         mask=mask,
+        dist=_distance_to_accept(trans, mask, g.eos_ok, tok, dead),
         start_state=start,
         dead_state=dead,
         accept_states=frozenset(g.eos_ok),
         tokenizer=tok,
     )
+
+
+_DIST_INF = np.iinfo(np.int32).max // 2
+
+
+def _distance_to_accept(
+    trans: np.ndarray,
+    mask: np.ndarray,
+    eos_ok: set[int],
+    tok: ByteTokenizer,
+    dead: int,
+) -> np.ndarray:
+    """``dist[s]`` = fewest sampled tokens to *finish* from state ``s``
+    (counting the final EOS sample). Multi-source reverse BFS: accept states
+    start at 1 (one EOS sample away); every byte edge adds 1. The decode loop
+    uses this to force the JSON closed before the token budget runs out —
+    so a budget-bounded constrained decode can never be truncated mid-plan.
+    """
+    n = trans.shape[0]
+    dist = np.full((n,), _DIST_INF, np.int64)
+    # Reverse adjacency over real byte edges (PAD self-loops and the
+    # post-EOS edge into `dead` are not generative moves).
+    preds: list[list[int]] = [[] for _ in range(n)]
+    for s in range(n):
+        for b in np.nonzero(mask[s])[0]:
+            if b == tok.eos_id or b == tok.pad_id:
+                continue
+            t = int(trans[s, b])
+            if t != dead:
+                preds[t].append(s)
+    frontier = sorted(eos_ok)
+    for s in frontier:
+        dist[s] = 1
+    while frontier:
+        nxt: list[int] = []
+        for t in frontier:
+            d = dist[t] + 1
+            for s in preds[t]:
+                if d < dist[s]:
+                    dist[s] = d
+                    nxt.append(s)
+        frontier = nxt
+    return dist.astype(np.int32)

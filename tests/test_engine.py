@@ -165,3 +165,30 @@ def test_generate_after_close_and_shutdown_drain():
             await eng.generate([1, 2, 3], max_new_tokens=4)
 
     asyncio.run(go())
+
+
+def test_budget_forced_completion():
+    """With budget >= grammar.min_len, constrained decode must emit a
+    COMPLETE grammar-accepted plan (budget-aware masking forces the JSON
+    closed) — even from random weights, at several budgets, with sampling."""
+
+    async def go():
+        eng = make_engine(temperature=0.8)
+        await eng.start()
+        try:
+            import json
+
+            prompt = eng.tokenizer.encode("plan: compose. JSON:")
+            for budget in [eng.grammar.min_len, eng.grammar.min_len + 5, 96]:
+                res = await eng.generate(prompt, max_new_tokens=budget)
+                # The forced EOS consumes one budget sample and is never
+                # emitted, so output bytes are strictly below the budget.
+                assert res.generated_tokens < budget
+                state = eng.grammar.walk(res.text)
+                assert eng.grammar.is_accept(state), (budget, res.text)
+                obj = json.loads(res.text)
+                assert obj["steps"], res.text
+        finally:
+            await eng.aclose()
+
+    asyncio.run(go())

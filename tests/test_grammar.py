@@ -93,3 +93,77 @@ def test_compact_keys_parse_to_plan():
     plan = Plan.from_json(text)
     assert [n.name for n in plan.nodes] == ["fetch", "rank"]
     assert plan.topological_generations() == [["fetch"], ["rank"]]
+
+
+def test_distance_to_accept():
+    """dist[s] must be the exact shortest completion length: simulate the
+    greedy 'always move closer' walk from every reachable state and check it
+    finishes in exactly dist[s] samples."""
+    g = build_plan_grammar()
+    tok = ByteTokenizer()
+    inf = np.iinfo(np.int32).max // 2
+    # Accept states are one EOS sample away.
+    for s in g.accept_states:
+        assert g.dist[s] == 1
+    assert g.dist[g.dead_state] >= inf
+    assert g.min_len == g.dist[g.start_state]
+    # The shortest valid plan really is min_len bytes + EOS.
+    shortest = '{"steps":[{"s":"?","in":[],"next":[]}]}'
+    assert g.is_accept(g.walk(shortest))
+    assert g.min_len == len(shortest) + 1
+    # Greedy-descent from every live reachable state terminates in dist[s].
+    reachable = {g.start_state}
+    frontier = [g.start_state]
+    while frontier:
+        nxt = []
+        for s in frontier:
+            for b in np.flatnonzero(g.mask[s]):
+                t = int(g.transitions[s, b])
+                if t != g.dead_state and t not in reachable:
+                    reachable.add(t)
+                    nxt.append(t)
+        frontier = nxt
+    for s in sorted(reachable):
+        d = int(g.dist[s])
+        assert d < inf, f"reachable state {s} cannot finish"
+        state, taken = s, 0
+        while state not in g.accept_states:
+            allowed = np.flatnonzero(g.mask[state])
+            succ = [
+                (int(g.dist[int(g.transitions[state, b])]), int(b))
+                for b in allowed
+                if b != tok.eos_id
+            ]
+            db, b = min(succ)
+            assert db == int(g.dist[state]) - 1  # BFS consistency
+            state = int(g.transitions[state, b])
+            taken += 1
+        assert taken + 1 == d, f"state {s}: took {taken}+EOS, dist={d}"
+
+
+def test_budget_mask_never_strands():
+    """Emulate the engine's budget mask host-side: any walk that only takes
+    tokens allowed by (grammar AND budget) finishes within the budget."""
+    rng = np.random.default_rng(1)
+    g = build_plan_grammar()
+    tok = ByteTokenizer()
+    for budget in [g.min_len, g.min_len + 1, g.min_len + 7, 96]:
+        for trial in range(20):
+            state, emitted, text = g.start_state, 0, []
+            while True:
+                rem = budget - emitted - 1  # samples left after this one
+                allowed = [
+                    int(b)
+                    for b in np.flatnonzero(g.mask[state])
+                    if b == tok.eos_id or int(g.dist[int(g.transitions[state, b])]) <= rem
+                ]
+                assert allowed, f"stranded at {state} budget={budget} emitted={emitted}"
+                b = int(rng.choice(allowed))
+                emitted += 1
+                if b == tok.eos_id:
+                    break
+                text.append(b)
+                state = int(g.transitions[state, b])
+                assert emitted < budget, "budget exceeded without EOS"
+            decoded = tok.decode(text)
+            assert g.is_accept(g.walk(decoded)), decoded
