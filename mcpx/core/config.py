@@ -26,6 +26,9 @@ class ServerConfig:
     # Max concurrent in-flight /plan_and_execute requests before 429.
     max_concurrency: int = 1024
     request_timeout_s: float = 120.0
+    # Where POST /profile/start writes jax.profiler traces (TensorBoard /
+    # Perfetto format) when the request doesn't name a directory.
+    profile_dir: str = "/tmp/mcpx-profile"
 
 
 @dataclass

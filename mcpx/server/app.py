@@ -11,6 +11,7 @@ plus the subsystems the reference only advertises:
   GET  /telemetry  per-service rolling stats snapshot
   GET/POST /services, GET/DELETE /services/{name}   registry CRUD
              (the reference has no registration API at all, README.md:86)
+  POST /profile/start, /profile/stop   jax.profiler device-trace capture
 
 Handlers are thin JSON shims over ``ControlPlane``; every request gets a
 trace ID and latency metrics. Fully async — planning never blocks the event
@@ -216,6 +217,45 @@ def build_app(cp: ControlPlane) -> web.Application:
         engine_state = getattr(engine, "state", "n/a") if engine is not None else "n/a"
         return web.json_response({"status": "ok", "engine": engine_state})
 
+    # Device-side profiling (SURVEY.md §5 tracing): capture a jax.profiler
+    # trace of live serving (prefill/decode/collectives) for TensorBoard /
+    # Perfetto, without restarting the server.
+    profile = {"dir": None}
+
+    async def profile_start(request: web.Request) -> web.Response:
+        body = await _body(request) if request.can_read_body else {}
+        if profile["dir"] is not None:
+            return _json_error(409, f"profiling already active (dir={profile['dir']})")
+        trace_dir = body.get("dir") or server_cfg.profile_dir
+        if not isinstance(trace_dir, str) or not trace_dir:
+            return _json_error(400, "'dir' must be a non-empty string")
+        try:
+            import jax
+        except ImportError:
+            return _json_error(501, "jax unavailable; device profiling disabled")
+        try:
+            await asyncio.to_thread(jax.profiler.start_trace, trace_dir)
+        except Exception as e:  # noqa: BLE001 - profiler state errors -> client
+            return _json_error(409, f"could not start trace: {e}")
+        profile["dir"] = trace_dir
+        return web.json_response({"profiling": "started", "dir": trace_dir})
+
+    async def profile_stop(request: web.Request) -> web.Response:
+        if profile["dir"] is None:
+            return _json_error(409, "profiling not active")
+        import jax
+
+        try:
+            # Off the event loop: stop_trace serializes the whole capture to
+            # disk, which can take seconds under real decode traffic.
+            await asyncio.to_thread(jax.profiler.stop_trace)
+        except Exception as e:  # noqa: BLE001
+            # Keep profile["dir"] set: jax's session state is unknown, and
+            # clearing it here would wedge both endpoints behind 409s.
+            return _json_error(500, f"could not stop trace: {e}")
+        trace_dir, profile["dir"] = profile["dir"], None
+        return web.json_response({"profiling": "stopped", "dir": trace_dir})
+
     app.router.add_post("/plan", plan)
     app.router.add_post("/execute", execute)
     app.router.add_post("/plan_and_execute", plan_and_execute)
@@ -226,8 +266,20 @@ def build_app(cp: ControlPlane) -> web.Application:
     app.router.add_get("/metrics", metrics_handler)
     app.router.add_get("/telemetry", telemetry_handler)
     app.router.add_get("/healthz", healthz)
+    app.router.add_post("/profile/start", profile_start)
+    app.router.add_post("/profile/stop", profile_stop)
 
     async def on_cleanup(app: web.Application) -> None:
+        if profile["dir"] is not None:
+            # stop_trace is what flushes the capture to disk; without this a
+            # trace active at shutdown would vanish silently.
+            import jax
+
+            try:
+                await asyncio.to_thread(jax.profiler.stop_trace)
+            except Exception:  # noqa: BLE001 - best-effort at shutdown
+                log.exception("failed to flush active profiler trace")
+            profile["dir"] = None
         await cp.orchestrator.aclose()
         engine = getattr(cp.planner, "engine", None)
         if engine is not None and engine.state in ("ready", "warming"):

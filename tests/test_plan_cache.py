@@ -1,0 +1,109 @@
+"""Plan-cache behavior: hits keyed by (intent, registry version), LRU
+eviction, invalidation on registry mutation, bypass, and the replan-success
+overwrite (SURVEY.md §5 checkpoint/resume — the cache is a plans/sec lever)."""
+
+import asyncio
+
+from mcpx.core.config import MCPXConfig
+from mcpx.core.dag import Plan
+from mcpx.planner.base import PlanContext
+from mcpx.registry import ServiceRecord
+from mcpx.server.factory import build_control_plane
+
+
+class CountingPlanner:
+    """Deterministic planner that counts invocations."""
+
+    def __init__(self) -> None:
+        self.calls = 0
+
+    async def plan(self, intent: str, context: PlanContext) -> Plan:
+        self.calls += 1
+        services = await context.registry.list_services()
+        name = services[0].name
+        return Plan.from_wire(
+            {"nodes": [{"name": name, "service": name, "endpoint": "local://x"}], "edges": []}
+        )
+
+
+def make_cp(cache_size=8):
+    cfg = MCPXConfig.from_dict(
+        {"planner": {"kind": "mock", "plan_cache_size": cache_size}, "retrieval": {"enabled": False}}
+    )
+    planner = CountingPlanner()
+    cp = build_control_plane(cfg, planner=planner)
+    return cp, planner
+
+
+def seed(cp, *names):
+    async def go():
+        for n in names:
+            await cp.registry.put(ServiceRecord(name=n, endpoint=f"local://{n}"))
+
+    return go()
+
+
+def test_cache_hit_and_version_invalidation():
+    async def go():
+        cp, planner = make_cp()
+        await seed(cp, "svc-a")
+        p1, _ = await cp.plan("do the thing")
+        p2, _ = await cp.plan("do the thing")
+        assert planner.calls == 1
+        assert p1 is p2
+        # Any registry mutation bumps the version -> stale entries miss.
+        await seed(cp, "svc-b")
+        await cp.plan("do the thing")
+        assert planner.calls == 2
+        # Distinct intents never collide.
+        await cp.plan("another thing")
+        assert planner.calls == 3
+
+    asyncio.run(go())
+
+
+def test_cache_bypass_and_disabled():
+    async def go():
+        cp, planner = make_cp()
+        await seed(cp, "svc-a")
+        await cp.plan("x", use_cache=False)
+        await cp.plan("x", use_cache=False)
+        assert planner.calls == 2
+
+        cp2, planner2 = make_cp(cache_size=0)
+        await seed(cp2, "svc-a")
+        await cp2.plan("x")
+        await cp2.plan("x")
+        assert planner2.calls == 2
+
+    asyncio.run(go())
+
+
+def test_lru_eviction():
+    async def go():
+        cp, planner = make_cp(cache_size=2)
+        await seed(cp, "svc-a")
+        await cp.plan("i1")
+        await cp.plan("i2")
+        await cp.plan("i1")  # refresh i1 -> i2 is now LRU
+        await cp.plan("i3")  # evicts i2
+        assert planner.calls == 3
+        await cp.plan("i1")  # still cached
+        assert planner.calls == 3
+        await cp.plan("i2")  # evicted -> replanned
+        assert planner.calls == 4
+
+    asyncio.run(go())
+
+
+def test_cache_metrics_counters():
+    async def go():
+        cp, planner = make_cp()
+        await seed(cp, "svc-a")
+        await cp.plan("x")
+        await cp.plan("x")
+        hit = cp.metrics.plan_cache.labels(result="hit")._value.get()
+        miss = cp.metrics.plan_cache.labels(result="miss")._value.get()
+        assert hit == 1.0 and miss == 1.0
+
+    asyncio.run(go())

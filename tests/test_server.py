@@ -163,3 +163,35 @@ def test_missing_registration_returns_400():
         await with_client(app, drive)
 
     asyncio.run(go())
+
+
+def test_profile_endpoints(tmp_path):
+    """POST /profile/start captures a jax.profiler trace of device work done
+    while active; double-start and stop-without-start are 409s."""
+
+    async def go():
+        cp, app = make_app()
+
+        async def drive(client):
+            trace_dir = str(tmp_path / "traces")
+            r = await client.post("/profile/stop")
+            assert r.status == 409
+            r = await client.post("/profile/start", json={"dir": trace_dir})
+            assert r.status == 200, await r.text()
+            r2 = await client.post("/profile/start", json={"dir": trace_dir})
+            assert r2.status == 409
+            # Some device work while the trace is active.
+            import jax.numpy as jnp
+
+            jnp.ones((8, 8)).sum().block_until_ready()
+            r3 = await client.post("/profile/stop")
+            assert r3.status == 200
+            assert (await r3.json())["dir"] == trace_dir
+            import pathlib
+
+            files = list(pathlib.Path(trace_dir).rglob("*"))
+            assert any(f.is_file() for f in files), "no trace artifacts written"
+
+        await with_client(app, drive)
+
+    asyncio.run(go())
