@@ -125,6 +125,7 @@ def _layer(
     mask: jax.Array,
     write_idx: jax.Array,
     cfg: GemmaConfig,
+    attend_fn=None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """One transformer block over [B, T]; writes K/V at ``write_idx``.
 
@@ -144,7 +145,7 @@ def _layer(
     v_cache = v_cache.at[b_idx, write_idx].set(v.astype(v_cache.dtype))
 
     qg = q.reshape(B, T, cfg.n_kv_heads, cfg.q_per_kv, cfg.head_dim)
-    attn = _attend(qg, k_cache, v_cache, mask)
+    attn = (attend_fn or _attend)(qg, k_cache, v_cache, mask)
     attn = attn.reshape(B, T, cfg.n_heads * cfg.head_dim)
     wo = lp["wo"].reshape(cfg.n_heads * cfg.head_dim, D)
     x = x + jnp.einsum("btf,fd->btd", attn, wo)
@@ -164,17 +165,19 @@ def forward(
     positions: jax.Array,
     kv_cache: KVCache,
     mask: jax.Array,
+    attend_fn=None,
 ) -> tuple[jax.Array, KVCache]:
     """Core forward over a [B, T] token chunk against a [L, B, S, K, hd]
     cache. ``positions`` are absolute (double as cache write slots);
-    ``mask`` is [B, T, S] (True = attend)."""
+    ``mask`` is [B, T, S] (True = attend). ``attend_fn`` swaps the attention
+    op (e.g. ring attention for sequence-parallel long-context prefill)."""
     x = params["embed"][tokens].astype(jnp.dtype(cfg.dtype))
     x = x * jnp.asarray(math.sqrt(cfg.d_model), x.dtype)
 
     def body(carry, scanned):
         x = carry
         lp, k_c, v_c = scanned
-        x, k_c, v_c = _layer(x, lp, k_c, v_c, positions, mask, positions, cfg)
+        x, k_c, v_c = _layer(x, lp, k_c, v_c, positions, mask, positions, cfg, attend_fn)
         return x, (k_c, v_c)
 
     x, (k_new, v_new) = lax.scan(

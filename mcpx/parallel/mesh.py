@@ -32,16 +32,27 @@ from mcpx.models.gemma.config import GemmaConfig
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
+SEQ_AXIS = "seq"  # sequence/context parallelism (ring attention)
 
 
 def make_mesh(
-    data: int = 1, model: int = 1, devices: Optional[Sequence[jax.Device]] = None
+    data: int = 1,
+    model: int = 1,
+    seq: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
+    """Named device mesh. The ``seq`` axis (between data and model, so ring
+    ppermute hops ride neighbouring ICI links) is only materialised when >1,
+    keeping the common 2-axis layout for the serving engine."""
     devices = list(devices if devices is not None else jax.devices())
-    if data * model > len(devices):
+    if data * seq * model > len(devices):
         raise ConfigError(
-            f"mesh {data}x{model} needs {data * model} devices, have {len(devices)}"
+            f"mesh {data}x{seq}x{model} needs {data * seq * model} devices, "
+            f"have {len(devices)}"
         )
+    if seq > 1:
+        grid = np.asarray(devices[: data * seq * model]).reshape(data, seq, model)
+        return Mesh(grid, (DATA_AXIS, SEQ_AXIS, MODEL_AXIS))
     grid = np.asarray(devices[: data * model]).reshape(data, model)
     return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
 
