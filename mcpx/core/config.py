@@ -68,6 +68,22 @@ class EngineConfig:
     top_k: int = 0  # 0 = full softmax sampling / greedy if temperature==0
     use_pallas: bool = True
     interpret: bool = False  # run Pallas kernels in interpret mode (CPU CI)
+    # Grammar fast-forward speculation: chunk width of the multi-token decode
+    # forward (1 sampled token + up to speculate_k-1 DFA-forced tokens per
+    # model call). Forced tokens (states with exactly one legal byte — JSON
+    # structure like '{"steps":[') need no sampling, only KV population, so
+    # this is exact, not probabilistic. <=1 disables (single-token loop).
+    speculate_k: int = 8
+    # Batch-size buckets requests are padded up to. Few buckets = few XLA
+    # compiles (each (B, T) pair is one prefill executable, each B one decode
+    # executable); padding rows are nearly free on TPU where decode is
+    # weight-load-bound. Empty = auto {1, 8, max_batch_size}.
+    batch_buckets: list = field(default_factory=list)
+    # Execute one batch per (B, T) bucket at startup so no compile lands in
+    # the serving path. Off by default: tests construct many engines.
+    warmup_compile: bool = False
+    # Largest prompt bucket the startup warmup compiles for.
+    warmup_max_len: int = 1024
 
 
 @dataclass
@@ -75,6 +91,13 @@ class RetrievalConfig:
     enabled: bool = True
     embed_dim: int = 256
     top_k: int = 8
+    # Where shortlist scoring runs: "host" (numpy), "device" (jit dot+top_k),
+    # or "auto" — host below `device_threshold` rows. At small N the dot
+    # product is microseconds on CPU, while a per-request device dispatch
+    # must queue BEHIND multi-second decode batches on a busy chip, which
+    # both inflates /plan latency and fragments engine batching.
+    compute: str = "auto"
+    device_threshold: int = 65536
     # Refresh the HBM table when the registry version changes.
     auto_refresh: bool = True
     # Optional .npz snapshot to load at startup (rebuildable from registry).

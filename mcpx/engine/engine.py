@@ -39,7 +39,7 @@ import numpy as np
 from mcpx.core.config import MCPXConfig
 from mcpx.core.errors import EngineError
 from mcpx.engine.kv_cache import PageAllocator, commit_prefill_to_pages, init_paged_kv
-from mcpx.engine.paged_decode import decode_step_paged
+from mcpx.engine.paged_decode import decode_chunk_paged, decode_step_paged
 from mcpx.engine.sampling import sample
 from mcpx.models.gemma.config import GemmaConfig
 from mcpx.models.gemma.model import init_kv_cache, prefill
@@ -123,10 +123,13 @@ class InferenceEngine:
                 f"that is a multiple of kv_page_size={ecfg.kv_page_size}"
             )
         # Always include max_batch_size itself so a fully-gathered batch
-        # has a bucket.
+        # has a bucket. Deliberately few buckets: each is one compiled
+        # executable per prefill length, and padding a batch up to the next
+        # bucket is nearly free on TPU (decode is weight-load-bound).
+        auto = {1, 8, ecfg.max_batch_size}
         self._batch_buckets = tuple(
             sorted(
-                {b for b in (1, 2, 4, 8, 16, 32, 64) if b < ecfg.max_batch_size}
+                {b for b in (tuple(ecfg.batch_buckets) or tuple(auto)) if b < ecfg.max_batch_size}
                 | {ecfg.max_batch_size}
             )
         )
@@ -143,18 +146,29 @@ class InferenceEngine:
 
     # ------------------------------------------------------------- lifecycle
     async def start(self) -> None:
-        """Build mesh, load weights, compile, spin up the worker thread."""
-        if self.state != "cold":
+        """Build mesh, load weights, compile, spin up the worker thread.
+
+        Concurrent callers coalesce: whoever arrives while another start is
+        in flight simply waits for it (the server launches startup as a
+        background task so /healthz can report "warming"; the first real
+        requests then block here until the engine is ready)."""
+        if self.state == "ready":
             return
-        self.state = "warming"
-        self._thread = threading.Thread(target=self._worker, daemon=True, name="mcpx-engine")
-        self._thread.start()
+        if self.state in ("closed", "failed"):
+            raise EngineError(f"engine not startable (state={self.state})")
+        if self.state == "cold":
+            self.state = "warming"
+            self._thread = threading.Thread(
+                target=self._worker, daemon=True, name="mcpx-engine"
+            )
+            self._thread.start()
         while not self._started.is_set():
             await asyncio.sleep(0.02)
         if self._startup_error is not None:
             self.state = "failed"
             raise EngineError(f"engine startup failed: {self._startup_error}")
-        self.state = "ready"
+        if self.state == "warming":
+            self.state = "ready"
 
     async def aclose(self) -> None:
         self.state = "closed"
@@ -219,8 +233,131 @@ class InferenceEngine:
             static_argnames=("steps", "temperature", "constrained"),
             donate_argnames=("paged_k", "paged_v", "out_buf"),
         )
+        self._jit_decode_spec = jax.jit(
+            functools.partial(self._decode_spec_impl),
+            static_argnames=("steps", "temperature", "chunk"),
+            donate_argnames=("paged_k", "paged_v", "out_buf"),
+        )
+        if ecfg.warmup_compile:
+            self._warmup()
+
+    def _warmup(self) -> None:
+        """Execute one batch per (B, T) bucket so every HOT executable is
+        compiled before the first real request (SURVEY.md §3.4: warmup is a
+        first-class startup phase; without it each new bucket costs seconds
+        of XLA compile *inside* the serving path). "Hot" = the constrained
+        decode at the engine's configured temperature — the planner's only
+        path; an unconstrained request or a non-default per-request
+        temperature still compiles on first use. Decode warms with all
+        sequences inactive: the while_loop exits after zero iterations, so
+        the cost is compile + prefill execution only."""
+        ecfg = self.config.engine
+        tok = self.tokenizer
+        steps = ecfg.max_decode_len
+        capacity = ecfg.max_pages_per_seq * ecfg.kv_page_size
+        t_buckets = [
+            t
+            for t in self._prefill_buckets
+            if t <= max(ecfg.warmup_max_len, self._prefill_buckets[0]) and t <= capacity
+        ]
+        if not t_buckets:
+            raise EngineError(
+                f"warmup: no prefill bucket fits page capacity {capacity} "
+                f"(kv_page_size*max_pages_per_seq); raise one of them"
+            )
+        for B in self._batch_buckets:
+            for T in t_buckets:
+                tokens = jnp.full((B, T), tok.pad_id, jnp.int32)
+                seq_lens = jnp.ones((B,), jnp.int32)
+                # Null page table: scatters land on reserved page 0, which
+                # no live sequence ever reads.
+                table = jnp.zeros((B, ecfg.max_pages_per_seq), jnp.int32)
+                last, k_p, v_p = self._jit_prefill(
+                    self._params,
+                    tokens,
+                    seq_lens,
+                    self._paged_kv["k"],
+                    self._paged_kv["v"],
+                    table,
+                    T=T,
+                )
+                self._paged_kv = {"k": k_p, "v": v_p}
+            inactive = jnp.zeros((B,), bool)
+            budgets = jnp.zeros((B,), jnp.int32)
+            out_buf = jnp.full((B, steps), tok.pad_id, jnp.int32)
+            seq_lens = jnp.ones((B,), jnp.int32)
+            table = jnp.zeros((B, ecfg.max_pages_per_seq), jnp.int32)
+            spec_chunk = self._spec_chunk(True)
+            args = (
+                self._params,
+                last,
+                seq_lens,
+                budgets,
+                table,
+                self._paged_kv["k"],
+                self._paged_kv["v"],
+                out_buf,
+                inactive,
+                jax.random.PRNGKey(0),
+            )
+            if spec_chunk > 1:
+                buf, st, done, k_p, v_p, _ = self._jit_decode_spec(
+                    *args, steps=steps, temperature=ecfg.temperature, chunk=spec_chunk
+                )
+            else:
+                buf, st, done, k_p, v_p, _ = self._jit_decode(
+                    *args, steps=steps, temperature=ecfg.temperature, constrained=True
+                )
+            self._paged_kv = {"k": k_p, "v": v_p}
+        jax.block_until_ready(self._paged_kv["k"])
+
+    def _spec_chunk(self, constrained: bool) -> int:
+        """Static speculation chunk width — config-derived only (it is a jit
+        static arg: one executable shared by warmup and every batch). On
+        configs whose page capacity can't spare the chunk's garbage-write
+        slack, speculation degrades toward 1 rather than failing."""
+        ecfg = self.config.engine
+        capacity = ecfg.max_pages_per_seq * ecfg.kv_page_size
+        want = ecfg.speculate_k if (constrained and ecfg.speculate_k > 1) else 1
+        budget_ceiling = min(ecfg.max_decode_len, capacity - 1)
+        return max(1, min(want, capacity - budget_ceiling))
 
     # --- jitted bodies ----------------------------------------------------
+    def _budget_mask(self, st, rem):
+        """Allow token t iff grammar-legal AND (t is EOS or the successor
+        state can still finish within the remaining sample budget) — this
+        forces the JSON closed before the budget runs out. When the budget
+        can't fit any completion at all (caller asked for fewer tokens than
+        the shortest valid plan), degrade to the plain grammar mask: the
+        output is then a legal prefix, never garbage. Shared by the plain
+        and speculative decode impls — their emission semantics must stay
+        identical (tested byte-for-byte)."""
+        trans, mask_tab, dist = self._dfa_trans, self._dfa_mask, self._dfa_dist
+        legal = mask_tab[st]
+        finishable = legal & (self._eos_onehot[None, :] | (dist[trans[st]] <= rem[:, None]))
+        feasible = jnp.any(finishable, axis=-1, keepdims=True)
+        return jnp.where(feasible, finishable, legal)
+
+    def _first_sample(self, first_logits, budgets, active, key, temperature, constrained):
+        """Sample the first emission from the prefill logits; returns
+        (cur0, state0, done0, key) with pad substituted for finished rows."""
+        tok = self.tokenizer
+        B = budgets.shape[0]
+        start_state = jnp.full((B,), self.grammar.start_state, jnp.int32)
+        key, sub = jax.random.split(key)
+        mask0 = self._budget_mask(start_state, budgets - 1) if constrained else None
+        first = sample(
+            first_logits,
+            sub,
+            temperature=temperature,
+            top_k=self.config.engine.top_k,
+            mask=mask0,
+        ).astype(jnp.int32)
+        done0 = (first == tok.eos_id) | ~active | (budgets < 1)
+        cur0 = jnp.where(done0, tok.pad_id, first)
+        state0 = self._dfa_trans[start_state, cur0]
+        return cur0, state0, done0, key
+
     def _prefill_impl(self, params, tokens, seq_lens, paged_k, paged_v, page_table, *, T):
         cfg = self.model_cfg
         B = tokens.shape[0]
@@ -255,30 +392,11 @@ class InferenceEngine:
     ):
         cfg = self.model_cfg
         tok = self.tokenizer
-        B = seq_lens.shape[0]
-        trans, mask_tab, dist = self._dfa_trans, self._dfa_mask, self._dfa_dist
-        eos_1h = self._eos_onehot
-        start_state = jnp.full((B,), self.grammar.start_state, jnp.int32)
-
-        def budget_mask(st, rem):
-            # Allow token t iff grammar-legal AND (t is EOS or the successor
-            # state can still finish within the remaining sample budget) —
-            # this forces the JSON closed before the budget runs out. When the
-            # budget can't fit any completion at all (caller asked for fewer
-            # tokens than the shortest valid plan), degrade to the plain
-            # grammar mask: the output is then a legal prefix, never garbage.
-            legal = mask_tab[st]
-            finishable = legal & (eos_1h[None, :] | (dist[trans[st]] <= rem[:, None]))
-            feasible = jnp.any(finishable, axis=-1, keepdims=True)
-            return jnp.where(feasible, finishable, legal)
-
-        key, sub = jax.random.split(key)
-        mask0 = budget_mask(start_state, budgets - 1) if constrained else None
-        first = sample(first_logits, sub, temperature=temperature, top_k=self.config.engine.top_k, mask=mask0)
-        first = first.astype(jnp.int32)
-        done0 = (first == tok.eos_id) | ~active | (budgets < 1)
-        cur0 = jnp.where(done0, tok.pad_id, first)
-        state0 = trans[start_state, cur0]
+        trans = self._dfa_trans
+        budget_mask = self._budget_mask
+        cur0, state0, done0, key = self._first_sample(
+            first_logits, budgets, active, key, temperature, constrained
+        )
 
         def cond(c):
             i, cur, pos, st, done, k_p, v_p, buf, key = c
@@ -324,7 +442,145 @@ class InferenceEngine:
             key,
         )
         i, cur, pos, st, done, k_p, v_p, buf, key = jax.lax.while_loop(cond, body, init)
-        return buf, st, done, k_p, v_p
+        return buf, st, done, k_p, v_p, i
+
+    def _decode_spec_impl(
+        self,
+        params,
+        first_logits,
+        seq_lens,
+        budgets,
+        page_table,
+        paged_k,
+        paged_v,
+        out_buf,
+        active,
+        key,
+        *,
+        steps: int,
+        temperature: float,
+        chunk: int,
+    ):
+        """Grammar fast-forward speculative decode (constrained only).
+
+        Identical emission semantics to ``_decode_impl`` with
+        ``constrained=True``, but each loop iteration runs ONE chunked
+        forward over [sampled token, forced tokens...] instead of one
+        forward per token. A token is *forced* when its DFA state has
+        exactly one legal successor byte — the constrained sample is then
+        deterministic regardless of logits, so the chain is exact (no
+        verification/rejection needed, unlike probabilistic speculation;
+        SURVEY.md §6's speculation lever, specialised to the plan grammar).
+        Per-sequence budget/EOS handling matches the plain path; greedy
+        outputs are bit-identical to it (tested).
+
+        Returns (buf, states, done, pools_k, pools_v, n_forwards).
+        """
+        cfg = self.model_cfg
+        tok = self.tokenizer
+        B = seq_lens.shape[0]
+        trans, mask_tab = self._dfa_trans, self._dfa_mask
+        budget_mask = self._budget_mask
+        pad, eos = tok.pad_id, tok.eos_id
+        b_idx = jnp.arange(B)
+        cur0, state0, done0, key = self._first_sample(
+            first_logits, budgets, active, key, temperature, True
+        )
+        e0 = jnp.where(done0, 0, 1).astype(jnp.int32)
+        buf0 = out_buf.at[b_idx, 0].set(cur0)
+
+        def cond(c):
+            it, cur, pos, st, e, done, k_p, v_p, buf, key = c
+            return (it < steps) & jnp.any(~done)
+
+        def body(c):
+            it, cur, pos, st, e, done, k_p, v_p, buf, key = c
+
+            # Fast-forward: chain of forced tokens after `cur`. Emission
+            # stops permanently at the first non-forced state (state
+            # freezes, emit stays False), at a forced EOS, or when the
+            # per-sequence budget is exhausted mid-chain (`over`, only
+            # reachable when the caller's budget is below the grammar's
+            # minimum completion length and the mask degraded to legal).
+            def ff_step(carry, _):
+                s, d, er = carry
+                row = mask_tab[s]  # [B, V]
+                t = jnp.argmax(row, axis=-1).astype(jnp.int32)
+                forced = (jnp.sum(row, axis=-1) == 1) & ~d
+                is_eos = forced & (t == eos)
+                emit = forced & ~is_eos & (er < budgets)
+                over = forced & ~is_eos & (er >= budgets)
+                return (
+                    jnp.where(emit, trans[s, t], s),
+                    d | is_eos | over,
+                    er + emit,
+                ), (jnp.where(emit, t, pad), emit)
+
+            (st1, done1, e1), (ff_toks, ff_emit) = jax.lax.scan(
+                ff_step, (st, done, e), None, length=chunk - 1
+            )
+            ff_toks = ff_toks.T  # [B, chunk-1]
+            ff_emit = ff_emit.T
+            # Forced tokens land at buf slots e, e+1, ...; non-emitted
+            # slots are routed out of range and dropped.
+            idx = jnp.where(ff_emit, e[:, None] + jnp.cumsum(ff_emit, axis=1) - 1, steps)
+            buf = buf.at[b_idx[:, None], idx].set(ff_toks, mode="drop")
+
+            # One chunked forward consumes [cur, forced...]; pad slots past
+            # a sequence's chain write garbage K/V that the next chunk
+            # overwrites (decode_chunk_paged contract).
+            chunk_toks = jnp.concatenate([cur[:, None], ff_toks], axis=1)
+            logits_all, kv = decode_chunk_paged(
+                params,
+                cfg,
+                chunk_toks,
+                pos,
+                page_table,
+                {"k": k_p, "v": v_p},
+                use_pallas=self._use_pallas,
+                interpret=self.config.engine.interpret,
+            )
+            adv = jnp.where(done, 0, 1) + jnp.sum(ff_emit, axis=1)  # tokens consumed
+            last_logits = logits_all[b_idx, jnp.maximum(adv - 1, 0)]  # [B, V]
+
+            key, sub = jax.random.split(key)
+            nxt = sample(
+                last_logits,
+                sub,
+                temperature=temperature,
+                top_k=self.config.engine.top_k,
+                mask=budget_mask(st1, budgets - e1 - 1),
+            ).astype(jnp.int32)
+            newly_done = done1 | (nxt == eos) | (e1 >= budgets)
+            nxt = jnp.where(newly_done, pad, nxt)
+            buf = buf.at[b_idx, jnp.where(newly_done, steps, e1)].set(nxt, mode="drop")
+            return (
+                it + 1,
+                nxt,
+                pos + adv,
+                trans[st1, nxt],
+                e1 + jnp.where(newly_done, 0, 1),
+                newly_done,
+                kv["k"],
+                kv["v"],
+                buf,
+                key,
+            )
+
+        init = (
+            jnp.asarray(0, jnp.int32),
+            cur0,
+            seq_lens,
+            state0,
+            e0,
+            done0,
+            paged_k,
+            paged_v,
+            buf0,
+            key,
+        )
+        it, cur, pos, st, e, done, k_p, v_p, buf, key = jax.lax.while_loop(cond, body, init)
+        return buf, st, done, k_p, v_p, it
 
     # --- worker -----------------------------------------------------------
     def _worker(self) -> None:
@@ -407,26 +663,44 @@ class InferenceEngine:
         B = _bucket(B_real, self._batch_buckets)
         # Batch-wide by worker invariant (see _worker's compat split).
         constrained = batch[0].constrained
-        max_new = max(r.max_new_tokens for r in batch)
-        steps = min(max_new, ecfg.max_decode_len)
-        # Prompts are trimmed to their tail (most recent context) so they fit
-        # both the largest prefill bucket and the per-sequence page budget
-        # (capacity must leave room for the decode steps).
+        # Decode steps are pinned to max_decode_len: `steps` is a static
+        # SHAPE (one executable per value; it only sizes out_buf) and the
+        # while_loop exits as soon as every sequence hits its own budget.
+        # Allocation and prompt-trim below use the batch's REAL budgets —
+        # those are data, not shapes, so short requests neither hold
+        # max_decode_len worth of pages nor lose prompt tail to it.
+        steps = ecfg.max_decode_len
         capacity = ecfg.max_pages_per_seq * ecfg.kv_page_size
-        if steps >= capacity:
+        # Grammar fast-forward speculation applies to constrained decodes
+        # only (unconstrained output has no DFA to force tokens from). The
+        # chunk's pad slots can write up to chunk-1 garbage positions past
+        # the final token, so allocations carry that much slack; on configs
+        # whose capacity can't spare it the chunk degrades toward 1
+        # (speculation is an optimisation, never a reason to fail).
+        spec_chunk = self._spec_chunk(constrained)
+        slack = spec_chunk - 1
+        # Per-sequence budget, capped so prompt(>=1) + budget + slack fits.
+        budget_cap = min(steps, capacity - 1 - slack)
+        if budget_cap < 1:
             raise EngineError(
-                f"decode budget {steps} exceeds page capacity {capacity} "
-                f"(max_pages_per_seq*kv_page_size)"
+                f"page capacity {capacity} (max_pages_per_seq*kv_page_size) "
+                f"cannot fit any decode budget"
             )
-        # Buckets above the page capacity would scatter more prefill chunks
-        # than the page table has columns.
+        budgets = np.zeros((B,), np.int32)
+        for i, r in enumerate(batch):
+            budgets[i] = min(r.max_new_tokens, budget_cap)
+        batch_budget = int(budgets[:B_real].max())
+        # Prompts are trimmed to their tail (most recent context) so they fit
+        # both the largest prefill bucket and the page budget. Buckets above
+        # the page capacity would scatter more prefill chunks than the page
+        # table has columns.
         eligible = tuple(b for b in self._prefill_buckets if b <= capacity)
         if not eligible:
             raise EngineError(
                 f"no prefill bucket fits page capacity {capacity}; "
                 f"raise max_pages_per_seq or kv_page_size"
             )
-        longest = min(eligible[-1], capacity - steps)
+        longest = min(eligible[-1], capacity - batch_budget - slack)
         max_prompt = min(longest, max(len(r.prompt_ids) for r in batch))
         T = _bucket(max_prompt, eligible)
 
@@ -439,21 +713,18 @@ class InferenceEngine:
             seq_lens[i] = len(ids)
             active[i] = True
 
-        # Pages for prompt + decode budget, allocated up front so the page
-        # table is static across the fused decode loop.
+        # Pages for prompt + this sequence's own decode budget (+ chunk
+        # slack), allocated up front so the page table is static across the
+        # fused decode loop.
         page_table = np.zeros((B, ecfg.max_pages_per_seq), np.int32)
         seq_ids = []
         for i in range(B_real):
             sid = (id(batch[i]), i)
-            pages = self._allocator.allocate(sid, int(seq_lens[i]) + steps)
+            pages = self._allocator.allocate(sid, int(seq_lens[i]) + int(budgets[i]) + slack)
             page_table[i, : len(pages)] = pages
             seq_ids.append(sid)
         self.metrics.kv_page_utilization.set(self._allocator.stats().utilization)
         self.metrics.batch_occupancy.set(B_real)
-
-        budgets = np.zeros((B,), np.int32)
-        for i, r in enumerate(batch):
-            budgets[i] = min(r.max_new_tokens, steps)
         try:
             t0 = time.monotonic()
             last_logits, k_p, v_p = self._jit_prefill(
@@ -473,22 +744,40 @@ class InferenceEngine:
             out_buf = jnp.full((B, steps), tok.pad_id, jnp.int32)
             # Batch-wide by worker invariant (see _worker's compat split).
             temperature = batch[0].temperature
-            buf, st, done, k_p, v_p = self._jit_decode(
-                self._params,
-                last_logits,
-                jnp.asarray(seq_lens),
-                jnp.asarray(budgets),
-                jnp.asarray(page_table),
-                k_p,
-                v_p,
-                out_buf,
-                jnp.asarray(active),
-                jax.random.PRNGKey(int(t0 * 1e6) & 0x7FFFFFFF),
-                steps=steps,
-                temperature=temperature,
-                constrained=constrained,
-            )
+            if spec_chunk > 1:
+                buf, st, done, k_p, v_p, n_fwd = self._jit_decode_spec(
+                    self._params,
+                    last_logits,
+                    jnp.asarray(seq_lens),
+                    jnp.asarray(budgets),
+                    jnp.asarray(page_table),
+                    k_p,
+                    v_p,
+                    out_buf,
+                    jnp.asarray(active),
+                    jax.random.PRNGKey(int(t0 * 1e6) & 0x7FFFFFFF),
+                    steps=steps,
+                    temperature=temperature,
+                    chunk=spec_chunk,
+                )
+            else:
+                buf, st, done, k_p, v_p, n_fwd = self._jit_decode(
+                    self._params,
+                    last_logits,
+                    jnp.asarray(seq_lens),
+                    jnp.asarray(budgets),
+                    jnp.asarray(page_table),
+                    k_p,
+                    v_p,
+                    out_buf,
+                    jnp.asarray(active),
+                    jax.random.PRNGKey(int(t0 * 1e6) & 0x7FFFFFFF),
+                    steps=steps,
+                    temperature=temperature,
+                    constrained=constrained,
+                )
             self._paged_kv = {"k": k_p, "v": v_p}
+            self.metrics.decode_forwards.inc(max(1, int(n_fwd)))
             buf_np = np.asarray(jax.device_get(buf))
             t1 = time.monotonic()
         finally:

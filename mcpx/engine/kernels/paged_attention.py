@@ -56,32 +56,69 @@ def paged_attention_reference(
     return out.astype(q.dtype)
 
 
+def paged_attention_chunk_reference(
+    q: jax.Array,  # [B, S, K, G, hd] — S new queries per sequence
+    k_pages: jax.Array,  # [K, N, Psz, hd]
+    v_pages: jax.Array,
+    page_table: jax.Array,  # [B, Pmax] int32
+    start_pos: jax.Array,  # [B] int32 — cache position of query 0
+) -> jax.Array:
+    """Chunked decode attention, pure jnp: query i of sequence b attends
+    through cache position ``start_pos[b]+i`` (itself + earlier chunk
+    tokens, already written to the pools). Gathers each sequence's pages
+    ONCE for all S queries — folding the chunk into the batch dim instead
+    would re-gather the same pages S times, which at chunk width 8 is 8x
+    the HBM traffic of this formulation (the dominant cost of jnp-path
+    decode). Returns [B, S, K, G, hd] in q.dtype."""
+    B, S, K, G, hd = q.shape
+    _, _, psz, _ = k_pages.shape
+    p_max = page_table.shape[1]
+    L = p_max * psz
+    k = k_pages[:, page_table].transpose(1, 0, 2, 3, 4).reshape(B, K, L, hd)
+    v = v_pages[:, page_table].transpose(1, 0, 2, 3, 4).reshape(B, K, L, hd)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+    logits = jnp.einsum("bskgh,bklh->bskgl", q, k, preferred_element_type=jnp.float32)
+    logits = logits * scale
+    vis = start_pos[:, None] + jnp.arange(S) + 1  # [B, S]
+    mask = jnp.arange(L)[None, None, :] < vis[:, :, None]  # [B, S, L]
+    logits = jnp.where(mask[:, :, None, None, :], logits, NEG_INF)
+    weights = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bskgl,bklh->bskgh", weights.astype(v.dtype), v)
+    return out.astype(q.dtype)
+
+
 # ------------------------------------------------------------------- kernel
-def _kernel(
+def _chunk_kernel(
     # scalar prefetch
     page_table_ref,  # [B, Pmax] SMEM
-    seq_lens_ref,  # [B] SMEM
+    start_pos_ref,  # [B] SMEM
     # blocks
-    q_ref,  # [1, 1, G, hd] VMEM
+    q_ref,  # [1, S, 1, G, hd] VMEM
     k_pages_ref,  # [K, N, Psz, hd] ANY (stays in HBM)
     v_pages_ref,
-    out_ref,  # [1, 1, G, hd] VMEM
+    out_ref,  # [1, S, 1, G, hd] VMEM
     # scratch
-    k_buf,  # [2, Psz, hd] VMEM
+    k_buf,  # [NBUF, Psz, hd] VMEM
     v_buf,
-    sem_k,  # DMA sems [2]
+    sem_k,  # DMA sems [NBUF]
     sem_v,
     *,
     page_size: int,
+    n_buf: int,
 ):
     b = pl.program_id(0)
     kh = pl.program_id(1)
-    seq_len = seq_lens_ref[b]
-    n_pages = pl.cdiv(seq_len, page_size)
-    G, hd = q_ref.shape[2], q_ref.shape[3]
+    S, G, hd = q_ref.shape[1], q_ref.shape[3], q_ref.shape[4]
+    start = start_pos_ref[b]
+    # The last chunk query attends through position start+S-1, so every page
+    # up to that position must stream in; earlier queries mask the tail.
+    n_pages = pl.cdiv(start + S, page_size)
 
-    q = q_ref[0, 0].astype(jnp.float32)  # [G, hd]
+    q = q_ref[0, :, 0].reshape(S * G, hd).astype(jnp.float32)
     scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+    # Visible length per q row r (row r is query r//G): start + r//G + 1.
+    row_q = lax.broadcasted_iota(jnp.int32, (S * G, 1), 0) // G
+    vis = start + row_q + 1  # [S*G, 1]
 
     def dma_k(slot, page_idx):
         return pltpu.make_async_copy(
@@ -93,20 +130,18 @@ def _kernel(
             v_pages_ref.at[kh, page_table_ref[b, page_idx]], v_buf.at[slot], sem_v.at[slot]
         )
 
-    @pl.when(n_pages > 0)
-    def _():
-        dma_k(0, 0).start()
-        dma_v(0, 0).start()
+    # Fill the pipeline: up to n_buf DMAs in flight hides per-transfer
+    # latency (the decode-attention bottleneck at small page sizes).
+    for j in range(n_buf):
+
+        @pl.when(j < n_pages)
+        def _():
+            dma_k(j, j).start()
+            dma_v(j, j).start()
 
     def body(i, carry):
-        m, l, acc = carry  # [G, 1], [G, 1], [G, hd] fp32
-        slot = lax.rem(i, 2)
-        nxt = lax.rem(i + 1, 2)
-
-        @pl.when(i + 1 < n_pages)
-        def _():
-            dma_k(nxt, i + 1).start()
-            dma_v(nxt, i + 1).start()
+        m, l, acc = carry  # [S*G, 1], [S*G, 1], [S*G, hd] fp32
+        slot = lax.rem(i, n_buf)
 
         dma_k(slot, i).wait()
         dma_v(slot, i).wait()
@@ -115,26 +150,80 @@ def _kernel(
 
         s = lax.dot_general(
             q, k_tile, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [G, Psz]
+        )  # [S*G, Psz]
         s = s * scale
         pos = i * page_size + lax.broadcasted_iota(jnp.int32, (1, page_size), 1)
-        s = jnp.where(pos < seq_len, s, NEG_INF)
+        s = jnp.where(pos < vis, s, NEG_INF)
 
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))  # [G, 1]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new)  # [G, Psz]
+        p = jnp.exp(s - m_new)  # [S*G, Psz]
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc_new = acc * alpha + lax.dot_general(
             p, v_tile, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
+
+        # Refill the slot we just drained with the page n_buf ahead.
+        @pl.when(i + n_buf < n_pages)
+        def _():
+            dma_k(slot, i + n_buf).start()
+            dma_v(slot, i + n_buf).start()
+
         return m_new, l_new, acc_new
 
-    m0 = jnp.full((G, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((G, 1), jnp.float32)
-    acc0 = jnp.zeros((G, hd), jnp.float32)
+    m0 = jnp.full((S * G, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((S * G, 1), jnp.float32)
+    acc0 = jnp.zeros((S * G, hd), jnp.float32)
     m, l, acc = lax.fori_loop(0, n_pages, body, (m0, l0, acc0))
     out = jnp.where(l > 0.0, acc / jnp.maximum(l, 1e-30), 0.0)
-    out_ref[0, 0] = out.astype(out_ref.dtype)
+    out_ref[0, :, 0] = out.reshape(S, G, hd).astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "n_buf"))
+def paged_attention_chunk(
+    q: jax.Array,  # [B, S, K, G, hd]
+    k_pages: jax.Array,  # [K, N, Psz, hd]
+    v_pages: jax.Array,
+    page_table: jax.Array,  # [B, Pmax]
+    start_pos: jax.Array,  # [B] — cache position of query 0
+    *,
+    interpret: bool = False,
+    n_buf: int = 4,
+) -> jax.Array:
+    """Chunked-decode Pallas kernel: grid (B, K); ONE program streams a
+    sequence's pages once for all S chunk queries ([S*G, hd] MXU rows/page
+    vs [G, hd] for the single-query kernel folded over B*S programs — S
+    times fewer DMA issues, S*G-row matmuls instead of G-row)."""
+    B, S, K, G, hd = q.shape
+    _, _, page_size, _ = k_pages.shape
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, K),
+        in_specs=[
+            pl.BlockSpec(
+                (1, S, 1, G, hd), lambda b, k, *_: (b, 0, k, 0, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, S, 1, G, hd), lambda b, k, *_: (b, 0, k, 0, 0), memory_space=pltpu.VMEM
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((n_buf, page_size, hd), k_pages.dtype),
+            pltpu.VMEM((n_buf, page_size, hd), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((n_buf,)),
+            pltpu.SemaphoreType.DMA((n_buf,)),
+        ],
+    )
+    kernel = functools.partial(_chunk_kernel, page_size=page_size, n_buf=n_buf)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), start_pos.astype(jnp.int32), q, k_pages, v_pages)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -147,33 +236,10 @@ def paged_attention(
     *,
     interpret: bool = False,
 ) -> jax.Array:
-    B, K, G, hd = q.shape
-    _, _, page_size, _ = k_pages.shape
-
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(B, K),
-        in_specs=[
-            pl.BlockSpec(
-                (1, 1, G, hd), lambda b, k, *_: (b, k, 0, 0), memory_space=pltpu.VMEM
-            ),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
-        out_specs=pl.BlockSpec(
-            (1, 1, G, hd), lambda b, k, *_: (b, k, 0, 0), memory_space=pltpu.VMEM
-        ),
-        scratch_shapes=[
-            pltpu.VMEM((2, page_size, hd), k_pages.dtype),
-            pltpu.VMEM((2, page_size, hd), v_pages.dtype),
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.DMA((2,)),
-        ],
+    """Single-query paged attention: the S=1 case of ``paged_attention_chunk``
+    (ONE streaming-softmax kernel to maintain; ``seq_lens`` counts the
+    just-written token, so the chunk's start position is ``seq_lens-1``)."""
+    out = paged_attention_chunk(
+        q[:, None], k_pages, v_pages, page_table, seq_lens - 1, interpret=interpret
     )
-    kernel = functools.partial(_kernel, page_size=page_size)
-    return pl.pallas_call(
-        kernel,
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
-        interpret=interpret,
-    )(page_table.astype(jnp.int32), seq_lens.astype(jnp.int32), q, k_pages, v_pages)
+    return out[:, 0]

@@ -18,11 +18,94 @@ import jax.numpy as jnp
 from jax import lax
 
 from mcpx.engine.kernels.paged_attention import (
-    paged_attention,
-    paged_attention_reference,
+    paged_attention_chunk,
+    paged_attention_chunk_reference,
 )
 from mcpx.models.gemma.config import GemmaConfig
 from mcpx.models.gemma.model import apply_rope, rms_norm
+
+
+def decode_chunk_paged(
+    params: dict[str, Any],
+    cfg: GemmaConfig,
+    tokens: jax.Array,  # [B, S] int32 — chunk of new tokens per sequence
+    positions: jax.Array,  # [B] int32 — slot tokens[:, 0] is written to
+    page_table: jax.Array,  # [B, Pmax] int32
+    paged_kv: dict[str, jax.Array],  # k/v: [L, K, N, Psz, hd]
+    *,
+    use_pallas: bool = True,
+    interpret: bool = False,
+) -> tuple[jax.Array, dict[str, jax.Array]]:
+    """Multi-token decode step: S new tokens per sequence in ONE forward.
+
+    This is the verify/extend pass for grammar fast-forward speculation
+    (SURVEY.md §6: "speculative decoding headroom"): forced-token chains
+    from the plan DFA need no sampling, only KV population and the logits
+    at the chain end — so S sequential decode steps collapse into one
+    forward whose per-token cost is amortised over the weight loads that
+    dominate decode on TPU. Query i of a sequence attends to the paged
+    cache through position ``positions+i`` (itself and earlier chunk
+    tokens included, written to the pools first); the attention itself is
+    the existing ragged paged kernel with the chunk folded into the batch
+    dimension ([B, S] → [B*S] queries, per-query seq_lens).
+
+    Tokens past a sequence's valid chain are pads; their K/V slots hold
+    garbage that the next chunk (which starts at the first invalid
+    position) overwrites, and their logits are ignored by the caller.
+    Returns ([B, S, V] logits, pools).
+    """
+    B, S = tokens.shape
+    psz = paged_kv["k"].shape[3]
+    x = params["embed"][tokens].astype(jnp.dtype(cfg.dtype))  # [B, S, D]
+    x = x * jnp.asarray(math.sqrt(cfg.d_model), x.dtype)
+
+    pos_mat = positions[:, None] + jnp.arange(S, dtype=positions.dtype)  # [B, S]
+    pages = jnp.take_along_axis(page_table, pos_mat // psz, axis=1)  # [B, S]
+    slots = pos_mat % psz  # [B, S]
+
+    def attend(q, k_pool, v_pool):
+        # Both paths stream/gather each sequence's pages ONCE for all S
+        # chunk queries (folding the chunk into the batch dim instead would
+        # multiply page traffic by S — the dominant decode cost).
+        qg = q.reshape(B, S, cfg.n_kv_heads, cfg.q_per_kv, cfg.head_dim)
+        if use_pallas:
+            out = paged_attention_chunk(
+                qg, k_pool, v_pool, page_table, positions, interpret=interpret
+            )
+        else:
+            out = paged_attention_chunk_reference(qg, k_pool, v_pool, page_table, positions)
+        return out.reshape(B, S, cfg.n_heads * cfg.head_dim)
+
+    def body(carry, scanned):
+        x = carry  # [B, S, D]
+        lp, k_pool, v_pool = scanned  # pools: [K, N, Psz, hd]
+        h = rms_norm(x, lp["pre_attn_norm"], cfg.norm_eps)
+        q = jnp.einsum("bsd,dkh->bskh", h, lp["wq"])  # [B, S, H, hd]
+        k = jnp.einsum("bsd,dkh->bskh", h, lp["wk"])  # [B, S, K, hd]
+        v = jnp.einsum("bsd,dkh->bskh", h, lp["wv"])
+        q = apply_rope(q, pos_mat, cfg.rope_theta)
+        k = apply_rope(k, pos_mat, cfg.rope_theta)
+        k_pool = k_pool.at[:, pages, slots].set(
+            k.transpose(2, 0, 1, 3).astype(k_pool.dtype)
+        )
+        v_pool = v_pool.at[:, pages, slots].set(
+            v.transpose(2, 0, 1, 3).astype(v_pool.dtype)
+        )
+        attn = attend(q, k_pool, v_pool)
+        wo = lp["wo"].reshape(cfg.n_heads * cfg.head_dim, cfg.d_model)
+        x = x + jnp.einsum("bsf,fd->bsd", attn, wo)
+        h = rms_norm(x, lp["pre_mlp_norm"], cfg.norm_eps)
+        ff = jax.nn.gelu(jnp.einsum("bsd,df->bsf", h, lp["w_gate"]), approximate=True)
+        ff = ff * jnp.einsum("bsd,df->bsf", h, lp["w_up"])
+        x = x + jnp.einsum("bsf,fd->bsd", ff, lp["w_down"])
+        return x, (k_pool, v_pool)
+
+    x, (k_new, v_new) = lax.scan(
+        body, x, (params["layers"], paged_kv["k"], paged_kv["v"])
+    )
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum("bsd,vd->bsv", x, params["embed"], preferred_element_type=jnp.float32)
+    return logits, {"k": k_new, "v": v_new}
 
 
 def decode_step_paged(
@@ -36,52 +119,20 @@ def decode_step_paged(
     use_pallas: bool = True,
     interpret: bool = False,
 ) -> tuple[jax.Array, dict[str, jax.Array]]:
-    """One decode step for the whole batch; returns ([B, V] logits, pools)."""
-    B = tokens.shape[0]
-    psz = paged_kv["k"].shape[3]
-    x = params["embed"][tokens].astype(jnp.dtype(cfg.dtype))  # [B, D]
-    x = x * jnp.asarray(math.sqrt(cfg.d_model), x.dtype)
+    """One decode step for the whole batch; returns ([B, V] logits, pools).
 
-    b_idx = jnp.arange(B)
-    pages = page_table[b_idx, positions // psz]  # [B]
-    slots = positions % psz  # [B]
-    seq_lens = positions + 1  # attend through the just-written token
-
-    def attend(q, k_pool, v_pool):
-        qg = q.reshape(B, cfg.n_kv_heads, cfg.q_per_kv, cfg.head_dim)
-        if use_pallas:
-            out = paged_attention(qg, k_pool, v_pool, page_table, seq_lens, interpret=interpret)
-        else:
-            out = paged_attention_reference(qg, k_pool, v_pool, page_table, seq_lens)
-        return out.reshape(B, cfg.n_heads * cfg.head_dim)
-
-    def body(carry, scanned):
-        x = carry  # [B, D]
-        lp, k_pool, v_pool = scanned  # pools: [K, N, Psz, hd]
-        h = rms_norm(x, lp["pre_attn_norm"], cfg.norm_eps)
-        q = jnp.einsum("bd,dkh->bkh", h, lp["wq"])  # [B, H, hd]
-        k = jnp.einsum("bd,dkh->bkh", h, lp["wk"])  # [B, K, hd]
-        v = jnp.einsum("bd,dkh->bkh", h, lp["wv"])
-        q = apply_rope(q[:, None], positions[:, None], cfg.rope_theta)[:, 0]
-        k = apply_rope(k[:, None], positions[:, None], cfg.rope_theta)[:, 0]
-        k_pool = k_pool.at[:, pages, slots].set(
-            k.transpose(1, 0, 2).astype(k_pool.dtype)
-        )
-        v_pool = v_pool.at[:, pages, slots].set(
-            v.transpose(1, 0, 2).astype(v_pool.dtype)
-        )
-        attn = attend(q, k_pool, v_pool)
-        wo = lp["wo"].reshape(cfg.n_heads * cfg.head_dim, cfg.d_model)
-        x = x + jnp.einsum("bf,fd->bd", attn, wo)
-        h = rms_norm(x, lp["pre_mlp_norm"], cfg.norm_eps)
-        ff = jax.nn.gelu(jnp.einsum("bd,df->bf", h, lp["w_gate"]), approximate=True)
-        ff = ff * jnp.einsum("bd,df->bf", h, lp["w_up"])
-        x = x + jnp.einsum("bf,fd->bd", ff, lp["w_down"])
-        return x, (k_pool, v_pool)
-
-    x, (k_new, v_new) = lax.scan(
-        body, x, (params["layers"], paged_kv["k"], paged_kv["v"])
+    The S=1 specialisation of ``decode_chunk_paged`` — a single forward body
+    to maintain (their equivalence is pinned by
+    ``test_decode_chunk_matches_sequential_steps``).
+    """
+    logits, pools = decode_chunk_paged(
+        params,
+        cfg,
+        tokens[:, None],
+        positions,
+        page_table,
+        paged_kv,
+        use_pallas=use_pallas,
+        interpret=interpret,
     )
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = jnp.einsum("bd,vd->bv", x, params["embed"], preferred_element_type=jnp.float32)
-    return logits, {"k": k_new, "v": v_new}
+    return logits[:, 0], pools

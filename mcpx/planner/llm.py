@@ -57,7 +57,9 @@ class LLMPlanner:
         if self.engine.state == "ready":
             return
         async with self._start_lock:
-            if self.engine.state == "cold":
+            if self.engine.state in ("cold", "warming"):
+                # start() coalesces: if the server already launched startup
+                # in the background, this just waits for it to finish.
                 await self.engine.start()
         if self.engine.state != "ready":
             raise PlannerError(f"inference engine unavailable (state={self.engine.state})")

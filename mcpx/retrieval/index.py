@@ -53,7 +53,8 @@ class RetrievalIndex:
         self._mesh = mesh
         self._lock = asyncio.Lock()
         self._names: list[str] = []
-        self._table: Optional[jax.Array] = None  # [N, d] on device
+        self._table: Optional[jax.Array] = None  # [N, d] on device (large N)
+        self._table_np: Optional[np.ndarray] = None  # [N, d] host mirror
         self._version: int = -1
 
     # ---------------------------------------------------------------- build
@@ -78,10 +79,19 @@ class RetrievalIndex:
             names = [s.name for s in services]
             texts = [s.schema_text() for s in services]
             table = await asyncio.to_thread(self.embedder.embed_texts, texts)
-            self._table = self._place(table)
+            self._table_np = table
+            self._table = self._place(table) if self._on_device(len(names)) else None
             self._names = names
             self._version = version
             return True
+
+    def _on_device(self, n_rows: int) -> bool:
+        mode = self.config.compute
+        if mode == "device":
+            return True
+        if mode == "host":
+            return False
+        return n_rows >= self.config.device_threshold
 
     def _place(self, table: np.ndarray) -> jax.Array:
         if self._mesh is None:
@@ -96,13 +106,22 @@ class RetrievalIndex:
 
     # ---------------------------------------------------------------- query
     async def shortlist(self, intent: str, k: int) -> list[str]:
-        """Top-k service names for an intent (on-device scoring)."""
-        if self._table is None or not self._names:
+        """Top-k service names for an intent. Scoring runs on device (HBM
+        table + lax.top_k) above the auto threshold, on host numpy below it
+        — a small-N device dispatch would queue behind in-flight decode
+        batches and stall the /plan hot path (see RetrievalConfig.compute)."""
+        if not self._names or k <= 0:
             return []
         k = min(k, len(self._names))
-        q = jnp.asarray(self.embedder.embed(intent))
-        _, idx = _topk_scores(self._table, q, k=k)
-        return [self._names[int(i)] for i in np.asarray(idx)]
+        q = self.embedder.embed(intent)
+        if self._table is not None:
+            _, idx = _topk_scores(self._table, jnp.asarray(q), k=k)
+            order = np.asarray(idx)
+        else:
+            scores = self._table_np @ q
+            part = np.argpartition(scores, -k)[-k:]
+            order = part[np.argsort(scores[part])[::-1]]
+        return [self._names[int(i)] for i in order]
 
     async def maybe_refresh(
         self, registry: RegistryBackend, version: Optional[int] = None
@@ -120,12 +139,12 @@ class RetrievalIndex:
 
     # ------------------------------------------------------------- snapshot
     def save(self, path: str) -> None:
-        if self._table is None:
+        if self._table_np is None:
             raise ValueError("nothing to snapshot: table not built")
         with open(path, "wb") as f:  # exact path (np.savez would append .npz)
             np.savez(
                 f,
-                table=np.asarray(self._table),
+                table=self._table_np,
                 names=np.asarray(self._names, dtype=object),
             )
 
@@ -136,6 +155,9 @@ class RetrievalIndex:
         against the live registry (the snapshot covers the window between
         process start and that first refresh)."""
         with np.load(path, allow_pickle=True) as z:
-            self._table = self._place(z["table"].astype(np.float32))
-            self._names = [str(n) for n in z["names"]]
-            self._version = -1
+            table = z["table"].astype(np.float32)
+            names = [str(n) for n in z["names"]]
+        self._table_np = table
+        self._table = self._place(table) if self._on_device(len(names)) else None
+        self._names = names
+        self._version = -1

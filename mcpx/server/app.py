@@ -269,7 +269,29 @@ def build_app(cp: ControlPlane) -> web.Application:
     app.router.add_post("/profile/start", profile_start)
     app.router.add_post("/profile/stop", profile_stop)
 
+    startup_task: dict[str, asyncio.Task] = {}
+
+    async def on_startup(app: web.Application) -> None:
+        # Engine bring-up (weight load + bucket compile warmup) runs as a
+        # background task, not inline: on_startup fires before the listening
+        # socket binds, so awaiting a minutes-long TPU warmup here would
+        # leave /healthz connection-refused the whole time (liveness probes
+        # would restart-loop the pod). Requests that arrive while warming
+        # wait inside engine.start(), which coalesces concurrent callers
+        # (SURVEY.md §3.4: startup is a first-class, observable phase).
+        startup_task["t"] = asyncio.create_task(cp.startup())
+
+    app.on_startup.append(on_startup)
+
     async def on_cleanup(app: web.Application) -> None:
+        t = startup_task.pop("t", None)
+        if t is not None:
+            if not t.done():
+                t.cancel()
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass  # failures already surface via engine.state / requests
         if profile["dir"] is not None:
             # stop_trace is what flushes the capture to disk; without this a
             # trace active at shutdown would vanish silently.

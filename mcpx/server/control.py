@@ -48,6 +48,16 @@ class ControlPlane:
         self.replan_policy = replan_policy or ReplanPolicy(self.config.telemetry)
         self._plan_cache: OrderedDict[tuple[str, int], Plan] = OrderedDict()
 
+    # ------------------------------------------------------------- lifecycle
+    async def startup(self) -> None:
+        """Bring the planner's inference engine up (mesh build, weight load,
+        bucket warmup) BEFORE serving traffic. Startup is minutes, not ms,
+        on TPU (SURVEY.md §3.4) — it must never hide inside the first
+        request, where per-request timeouts would shoot it down."""
+        ensure = getattr(self.planner, "ensure_ready", None)
+        if ensure is not None:
+            await ensure()
+
     # ------------------------------------------------------------------ plan
     async def plan(self, intent: str, *, use_cache: bool = True) -> tuple[Plan, float]:
         """Plan an intent; returns (plan, latency_ms)."""

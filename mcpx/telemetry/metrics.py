@@ -68,6 +68,12 @@ class Metrics:
         self.decode_tokens = Counter(
             "mcpx_engine_decode_tokens_total", "Tokens decoded", registry=self.registry
         )
+        self.decode_forwards = Counter(
+            "mcpx_engine_decode_forwards_total",
+            "Decode-loop model forwards (tokens/forwards > 1 under grammar "
+            "fast-forward speculation)",
+            registry=self.registry,
+        )
 
     def render(self) -> bytes:
         return generate_latest(self.registry)

@@ -167,6 +167,59 @@ def test_generate_after_close_and_shutdown_drain():
     asyncio.run(go())
 
 
+def test_warmup_compile_then_serve():
+    """warmup_compile pre-executes every (B, T) bucket; the engine must come
+    up ready and serve correctly afterward (null-page warmup traffic must
+    not disturb real sequences)."""
+
+    async def go():
+        eng = make_engine(warmup_compile=True, warmup_max_len=64, max_decode_len=24)
+        await eng.start()
+        try:
+            res = await eng.generate(
+                eng.tokenizer.encode("plan:"), max_new_tokens=24
+            )
+            assert eng.grammar.walk(res.text) != eng.grammar.dead_state
+            stats = eng._allocator.stats()
+            assert stats.sequences == 0  # warmup holds no pages
+        finally:
+            await eng.aclose()
+
+    asyncio.run(go())
+
+
+def test_speculative_matches_plain_greedy():
+    """Grammar fast-forward speculation is exact: greedy constrained output
+    must be byte-identical with speculation on vs off, across budgets
+    (including the forced-completion edge at grammar.min_len), while doing
+    strictly fewer model forwards than tokens emitted."""
+
+    async def go():
+        eng_plain = make_engine(speculate_k=0)
+        eng_spec = make_engine(speculate_k=8)
+        await eng_plain.start()
+        await eng_spec.start()
+        try:
+            prompts = [
+                eng_plain.tokenizer.encode("plan: compose the services. JSON:"),
+                eng_plain.tokenizer.encode("q"),
+            ]
+            budgets = [eng_plain.grammar.min_len, 24, 96]
+            for prompt in prompts:
+                for budget in budgets:
+                    plain = await eng_plain.generate(prompt, max_new_tokens=budget)
+                    spec = await eng_spec.generate(prompt, max_new_tokens=budget)
+                    assert spec.text == plain.text, (budget, spec.text, plain.text)
+            fwd = eng_spec.metrics.decode_forwards._value.get()
+            toks = eng_spec.metrics.decode_tokens._value.get()
+            assert fwd < toks, f"speculation did not amortise: {fwd} forwards / {toks} tokens"
+        finally:
+            await eng_plain.aclose()
+            await eng_spec.aclose()
+
+    asyncio.run(go())
+
+
 def test_budget_forced_completion():
     """With budget >= grammar.min_len, constrained decode must emit a
     COMPLETE grammar-accepted plan (budget-aware masking forces the JSON

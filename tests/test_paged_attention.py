@@ -103,6 +103,149 @@ def test_commit_and_decode_write_roundtrip():
     )
 
 
+def test_chunk_reference_matches_per_query_fold():
+    """paged_attention_chunk_reference == per-query reference with the chunk
+    folded into the batch dim (the two formulations the decode paths use)."""
+    from mcpx.engine.kernels.paged_attention import paged_attention_chunk_reference
+
+    B, S, K, G, hd, psz, p_max = 2, 4, 2, 3, 16, 4, 6
+    n_pages = B * p_max + 1
+    ks = jax.random.split(jax.random.PRNGKey(5), 3)
+    q = jax.random.normal(ks[0], (B, S, K, G, hd), jnp.float32)
+    kp = jax.random.normal(ks[1], (K, n_pages, psz, hd), jnp.float32)
+    vp = jax.random.normal(ks[2], (K, n_pages, psz, hd), jnp.float32)
+    table = jnp.asarray(np.arange(B * p_max, dtype=np.int32).reshape(B, p_max) + 1)
+    start = jnp.array([2, 9], jnp.int32)
+
+    chunk = paged_attention_chunk_reference(q, kp, vp, table, start)
+
+    pos = start[:, None] + jnp.arange(S)  # [B, S]
+    fold = paged_attention_reference(
+        q.reshape(B * S, K, G, hd),
+        kp,
+        vp,
+        jnp.broadcast_to(table[:, None], (B, S, p_max)).reshape(B * S, p_max),
+        (pos + 1).reshape(B * S),
+    ).reshape(B, S, K, G, hd)
+    np.testing.assert_allclose(np.asarray(chunk), np.asarray(fold), rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize(
+    "B,S,K,G,hd,psz,maxstart",
+    [
+        (1, 8, 1, 8, 128, 16, 40),  # MQA chunk (Gemma-2B shape class)
+        (3, 4, 2, 2, 128, 16, 50),  # GQA, ragged starts
+        (2, 1, 4, 1, 256, 8, 17),   # S=1 degenerate (plain decode step)
+    ],
+)
+def test_chunk_kernel_matches_chunk_reference(B, S, K, G, hd, psz, maxstart):
+    from mcpx.engine.kernels.paged_attention import (
+        paged_attention_chunk,
+        paged_attention_chunk_reference,
+    )
+
+    p_max = -(-(maxstart + S) // psz) + 1
+    n_pages = B * p_max + 2
+    ks = jax.random.split(jax.random.PRNGKey(B * 10 + S), 4)
+    q = jax.random.normal(ks[0], (B, S, K, G, hd), jnp.float32)
+    kp = jax.random.normal(ks[1], (K, n_pages, psz, hd), jnp.float32)
+    vp = jax.random.normal(ks[2], (K, n_pages, psz, hd), jnp.float32)
+    rng = random.Random(7)
+    starts = jnp.asarray([rng.randint(0, maxstart) for _ in range(B)], jnp.int32)
+    table = np.zeros((B, p_max), np.int32)
+    used = {0}
+    for b in range(B):
+        for i in range(p_max):
+            p = rng.choice([x for x in range(1, n_pages) if x not in used])
+            used.add(p)
+            table[b, i] = p
+    table = jnp.asarray(table)
+    ref = paged_attention_chunk_reference(q, kp, vp, table, starts)
+    out = paged_attention_chunk(q, kp, vp, table, starts, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_decode_chunk_matches_sequential_steps():
+    """decode_chunk_paged(S tokens) == S x decode_step_paged: same logits at
+    every chunk position and identical page pools afterward (the speculation
+    verify pass must be an exact re-expression of sequential decode)."""
+    from mcpx.engine.paged_decode import decode_chunk_paged, decode_step_paged
+    from mcpx.models.gemma.model import init_params
+
+    cfg = GemmaConfig(
+        dtype="float32", d_model=32, n_layers=2, n_heads=4, n_kv_heads=2, head_dim=8, d_ff=64
+    )
+    B, S, psz, p_max = 2, 5, 4, 4
+    n_pages = B * p_max + 1
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    pool0 = {
+        "k": jax.random.normal(
+            jax.random.PRNGKey(1), (cfg.n_layers, cfg.n_kv_heads, n_pages, psz, cfg.head_dim)
+        ),
+        "v": jax.random.normal(
+            jax.random.PRNGKey(2), (cfg.n_layers, cfg.n_kv_heads, n_pages, psz, cfg.head_dim)
+        ),
+    }
+    table = jnp.asarray(np.arange(B * p_max, dtype=np.int32).reshape(B, p_max) + 1)
+    pos0 = jnp.array([3, 6], jnp.int32)  # mid-page, ragged starts
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (B, S), 0, cfg.vocab_size)
+
+    seq_pool = {k: v for k, v in pool0.items()}
+    seq_logits = []
+    for i in range(S):
+        lg, seq_pool = decode_step_paged(
+            params, cfg, tokens[:, i], pos0 + i, table, seq_pool, use_pallas=False
+        )
+        seq_logits.append(lg)
+    seq_logits = jnp.stack(seq_logits, axis=1)  # [B, S, V]
+
+    chunk_logits, chunk_pool = decode_chunk_paged(
+        params, cfg, tokens, pos0, table, pool0, use_pallas=False
+    )
+    np.testing.assert_allclose(
+        np.asarray(chunk_logits), np.asarray(seq_logits), rtol=2e-5, atol=2e-5
+    )
+    for key in ("k", "v"):
+        np.testing.assert_allclose(
+            np.asarray(chunk_pool[key]), np.asarray(seq_pool[key]), rtol=2e-5, atol=2e-5
+        )
+
+
+def test_decode_chunk_pallas_interpret_matches_reference_path():
+    """Chunk forward with the Pallas kernel (interpret mode) == jnp path."""
+    from mcpx.engine.paged_decode import decode_chunk_paged
+    from mcpx.models.gemma.model import init_params
+
+    cfg = GemmaConfig(
+        dtype="float32", d_model=32, n_layers=1, n_heads=2, n_kv_heads=1, head_dim=128, d_ff=64
+    )
+    B, S, psz, p_max = 2, 3, 4, 3
+    n_pages = B * p_max + 1
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    pool0 = {
+        "k": jax.random.normal(
+            jax.random.PRNGKey(1), (cfg.n_layers, cfg.n_kv_heads, n_pages, psz, cfg.head_dim)
+        ),
+        "v": jax.random.normal(
+            jax.random.PRNGKey(2), (cfg.n_layers, cfg.n_kv_heads, n_pages, psz, cfg.head_dim)
+        ),
+    }
+    table = jnp.asarray(np.arange(B * p_max, dtype=np.int32).reshape(B, p_max) + 1)
+    pos0 = jnp.array([1, 5], jnp.int32)
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (B, S), 0, cfg.vocab_size)
+    ref_logits, ref_pool = decode_chunk_paged(
+        params, cfg, tokens, pos0, table, pool0, use_pallas=False
+    )
+    pal_logits, pal_pool = decode_chunk_paged(
+        params, cfg, tokens, pos0, table, pool0, use_pallas=True, interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(pal_logits), np.asarray(ref_logits), rtol=2e-5, atol=2e-5
+    )
+    for key in ("k", "v"):
+        np.testing.assert_allclose(np.asarray(pal_pool[key]), np.asarray(ref_pool[key]))
+
+
 def test_allocator_invariants():
     a = PageAllocator(n_pages=32, page_size=8, max_pages_per_seq=8)
     p1 = a.allocate(1, 20)  # 3 pages

@@ -86,10 +86,12 @@ def test_empty_registry_and_k_clamp():
 def test_sharded_table_matches_single_device():
     async def go():
         reg = await _registry(n_extra=21)  # 24 rows: divisible by model axis 4
-        plain = RetrievalIndex()
+        # compute="device" pins the table to HBM regardless of row count
+        # (auto mode keeps small tables on host, see RetrievalConfig).
+        plain = RetrievalIndex(RetrievalConfig(compute="device"))
         await plain.refresh(reg)
         mesh = make_mesh(data=2, model=4)
-        sharded = RetrievalIndex(mesh=mesh)
+        sharded = RetrievalIndex(RetrievalConfig(compute="device"), mesh=mesh)
         await sharded.refresh(reg)
         assert isinstance(sharded._table.sharding, NamedSharding)
         q = "analyse the sentiment of customer reviews"
@@ -100,6 +102,23 @@ def test_sharded_table_matches_single_device():
             np.asarray(plain._table @ qv), np.asarray(sharded._table @ qv), atol=1e-6
         )
         assert (await plain.shortlist(q, 5))[0] == (await sharded.shortlist(q, 5))[0] == "sentiment"
+
+    asyncio.run(go())
+
+
+def test_host_and_device_scoring_agree():
+    """Auto mode keeps small tables on host numpy; the shortlist must match
+    the on-device jit path exactly (same scores, same winner)."""
+
+    async def go():
+        reg = await _registry(n_extra=10)
+        host = RetrievalIndex(RetrievalConfig(compute="host"))
+        dev = RetrievalIndex(RetrievalConfig(compute="device"))
+        await host.refresh(reg)
+        await dev.refresh(reg)
+        assert host._table is None and dev._table is not None
+        q = "analyse the sentiment of customer reviews"
+        assert (await host.shortlist(q, 3))[0] == (await dev.shortlist(q, 3))[0]
 
     asyncio.run(go())
 
