@@ -1,0 +1,263 @@
+#!/usr/bin/env python
+"""Baseline config ladder — one run per BASELINE.json scenario.
+
+The reference publishes no numbers (SURVEY.md §6); the operative baseline is
+the driver-defined config ladder. Each scenario drives the REAL server stack
+(aiohttp app, retrieval shortlist, grammar-constrained batched decode,
+concurrent orchestrator over in-process fake microservices) and prints one
+JSON line:
+
+    {"config": N, "desc": ..., "value": ..., "unit": ..., ...}
+
+Configs (BASELINE.json "configs"):
+  1. single-intent /plan -> linear DAG          (3-service registry)
+  2. /plan_and_execute, per-node retry+fallback (10-service registry)
+  3. batched /plan bs=32, top-k retrieval       (100-service registry)
+  4. telemetry-adaptive replanning loop
+  5. 256-concurrent /plan_and_execute fan-out   (1k-service registry)
+
+Model: "2b" on TPU, "test" on CPU (MCPX_BENCH_MODEL overrides).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import statistics
+import sys
+import time
+
+# Runnable as `python benchmarks/ladder.py` from the repo root.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _on_tpu() -> bool:
+    import jax
+
+    return jax.default_backend() not in ("cpu",)
+
+
+def _config(model_size: str, max_batch: int = 32):
+    from mcpx.core.config import MCPXConfig
+
+    return MCPXConfig.from_dict(
+        {
+            "model": {"size": model_size, "max_seq_len": 2048},
+            "engine": {
+                "max_batch_size": max_batch,
+                "max_decode_len": 96,
+                "kv_page_size": 64,
+                "max_pages_per_seq": 20,
+                "temperature": 0.0,
+                "use_pallas": _on_tpu(),
+                "warmup_compile": _on_tpu(),
+            },
+            "planner": {"kind": "llm", "max_plan_retries": 0, "shortlist_top_k": 8},
+        }
+    )
+
+
+class _Stack:
+    """Server + registry + fake local microservices for one scenario."""
+
+    def __init__(self, n_services: int, model: str, *, fail: dict | None = None):
+        self.n_services = n_services
+        self.model = model
+        self.fail = fail or {}  # name -> "once" | "always"
+
+    async def __aenter__(self):
+        from aiohttp.test_utils import TestServer
+
+        from mcpx.orchestrator.transport import TransportError
+        from mcpx.server.app import build_app
+        from mcpx.server.factory import build_control_plane
+        from mcpx.utils.synth import synth_registry
+
+        self.cp = build_control_plane(_config(self.model))
+        self.records = synth_registry(self.n_services, seed=7)
+        calls: dict[str, int] = {}
+
+        def handler_for(name: str, mode: str | None):
+            async def handler(payload):
+                calls[name] = calls.get(name, 0) + 1
+                if mode == "always" or (mode == "once" and calls[name] == 1):
+                    raise TransportError(f"{name} injected failure")
+                return {"service": name, "ok": True}
+
+            return handler
+
+        local = self.cp.orchestrator._transport.local
+        for rec in self.records:
+            await self.cp.registry.put(rec)
+            local.register(rec.name, handler_for(rec.name, self.fail.get(rec.name)))
+            for fb in rec.fallbacks:
+                fb_name = fb.removeprefix("local://")
+                local.register(fb_name, handler_for(fb_name, None))
+        self.server = TestServer(build_app(self.cp))
+        await self.server.start_server()
+        self.base = f"http://{self.server.host}:{self.server.port}"
+
+        import aiohttp
+
+        self.session = aiohttp.ClientSession(
+            connector=aiohttp.TCPConnector(limit=512)
+        )
+        # Wait for background engine bring-up, then one warmup round so no
+        # XLA compile lands in the timed region.
+        while True:
+            async with self.session.get(f"{self.base}/healthz") as r:
+                h = await r.json()
+            if h.get("engine") in ("ready", "n/a"):
+                break
+            if h.get("engine") == "failed":
+                raise RuntimeError("engine failed during startup")
+            await asyncio.sleep(0.5)
+        bs = self.cp.config.engine.max_batch_size
+        await asyncio.gather(*(self.plan(f"warmup {i}") for i in range(bs)))
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.session.close()
+        await self.server.close()
+        engine = getattr(self.cp.planner, "engine", None)
+        if engine is not None and engine.state == "ready":
+            await engine.aclose()
+
+    async def plan(self, intent: str) -> dict:
+        async with self.session.post(f"{self.base}/plan", json={"intent": intent}) as r:
+            return {"status": r.status, **(await r.json())}
+
+    async def plan_and_execute(self, intent: str, payload: dict) -> dict:
+        async with self.session.post(
+            f"{self.base}/plan_and_execute", json={"intent": intent, "payload": payload}
+        ) as r:
+            return {"http": r.status, **(await r.json())}
+
+
+def _emit(config: int, desc: str, value, unit: str, **extra):
+    print(
+        json.dumps(
+            {"config": config, "desc": desc, "value": round(value, 2), "unit": unit, **extra}
+        ),
+        flush=True,
+    )
+
+
+async def config1(model: str) -> None:
+    """Single-intent /plan over a 3-service registry: p50 latency."""
+    async with _Stack(3, model) as st:
+        lat = []
+        nodes = 0
+        for i in range(24):
+            t0 = time.monotonic()
+            res = await st.plan(f"fetch auth data then enrich the user record [{i}]")
+            lat.append((time.monotonic() - t0) * 1e3)
+            assert res["status"] == 200, res
+            nodes = max(nodes, len(res["graph"]["nodes"]))
+        _emit(1, "single /plan p50 (3 services)", statistics.median(lat), "ms",
+              max_plan_nodes=nodes)
+
+
+async def config2(model: str) -> None:
+    """/plan_and_execute with retry + ordered fallback on a 10-service registry."""
+    from mcpx.utils.synth import synth_registry
+
+    records = synth_registry(10, seed=7)
+    # One flaky service (first call fails -> retry) and one hard-down service
+    # that has a declared fallback endpoint.
+    flaky = records[0].name
+    downed = next((r.name for r in records if r.fallbacks), records[1].name)
+    async with _Stack(10, model, fail={flaky: "once", downed: "always"}) as st:
+        ok = retries = fallbacks = 0
+        lat = []
+        payload = {k: "x" for k in
+                   ("query", "user_id", "order_id", "document", "text", "items", "amount",
+                    "address", "score", "status", "report", "features", "vector", "summary")}
+        for i in range(12):
+            t0 = time.monotonic()
+            res = await st.plan_and_execute(f"fetch auth then validate user then report [{i}]",
+                                            payload)
+            lat.append((time.monotonic() - t0) * 1e3)
+            ok += res.get("status") in ("ok", "partial")
+            for node in (res.get("trace") or {}).get("nodes", []):
+                kinds = [a["kind"] for a in node.get("attempts", [])]
+                retries += "retry" in kinds
+                fallbacks += "fallback" in kinds
+        _emit(2, "plan_and_execute p50 w/ retry+fallback (10 services)",
+              statistics.median(lat), "ms", ok=ok, total=12,
+              retries_exercised=retries, fallbacks_exercised=fallbacks)
+
+
+async def config3(model: str) -> None:
+    """Batched /plan bs=32 with top-k retrieval over 100 services."""
+    import random
+
+    from mcpx.utils.synth import intent_for
+
+    async with _Stack(100, model) as st:
+        rng = random.Random(3)
+        intents = [f"{intent_for(st.records, rng)} [{i}]" for i in range(96)]
+        t0 = time.monotonic()
+        results = await asyncio.gather(*(st.plan(i) for i in intents))
+        dt = time.monotonic() - t0
+        assert all(r["status"] == 200 for r in results)
+        _emit(3, "batched /plan throughput, top-k retrieval (100 services)",
+              len(intents) / dt, "plans/s", batch=32)
+
+
+async def config4(model: str) -> None:
+    """Telemetry-adaptive replanning: a degraded service gets planned around."""
+    from mcpx.utils.synth import synth_registry
+
+    records = synth_registry(10, seed=7)
+    bad = records[2].name
+    async with _Stack(10, model, fail={bad: "always"}) as st:
+        payload = {"query": "q", "user_id": "u", "items": "i", "document": "d",
+                   "amount": "1", "report": "r", "score": "s", "text": "t"}
+        recovered = replans = 0
+        n = 10
+        for i in range(n):
+            res = await st.plan_and_execute(
+                f"enrich order data then score and report it [{i}]", payload)
+            replans += res.get("replans", 0)
+            recovered += res.get("status") == "ok" and res.get("replans", 0) > 0
+        _emit(4, "telemetry-adaptive replanning (degraded service)",
+              replans, "replans", recovered_requests=recovered, requests=n)
+
+
+async def config5(model: str) -> None:
+    """256 concurrent /plan_and_execute fan-out/fan-in over 1k services."""
+    import random
+
+    from mcpx.utils.synth import intent_for
+
+    async with _Stack(1000, model) as st:
+        rng = random.Random(5)
+        payload = {k: "x" for k in
+                   ("query", "user_id", "order_id", "document", "text", "items", "amount",
+                    "address", "score", "status", "report", "features", "vector", "summary")}
+        intents = [f"{intent_for(st.records, rng, 4)} fan out and merge [{i}]"
+                   for i in range(256)]
+        t0 = time.monotonic()
+        results = await asyncio.gather(
+            *(st.plan_and_execute(i, payload) for i in intents)
+        )
+        dt = time.monotonic() - t0
+        ok = sum(r.get("status") in ("ok", "partial") for r in results)
+        _emit(5, "256-concurrent plan_and_execute (1k services)",
+              len(intents) / dt, "req/s", ok=ok, total=len(intents))
+
+
+async def main() -> None:
+    model = os.environ.get("MCPX_BENCH_MODEL") or ("2b" if _on_tpu() else "test")
+    only = os.environ.get("MCPX_LADDER_ONLY")
+    configs = [config1, config2, config3, config4, config5]
+    for i, cfg in enumerate(configs, start=1):
+        if only and str(i) not in only.split(","):
+            continue
+        await cfg(model)
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -678,7 +678,12 @@ class InferenceEngine:
         # whose capacity can't spare it the chunk degrades toward 1
         # (speculation is an optimisation, never a reason to fail).
         spec_chunk = self._spec_chunk(constrained)
-        slack = spec_chunk - 1
+        # Slack covers the chunk's garbage writes PAST a sequence's last
+        # token. A row that finishes by exhausting its budget ends with
+        # pos = seq_len + budget (one past its final token), and later
+        # chunks for that done row touch pos .. pos+chunk-1 — so the slack
+        # is the full chunk width, not chunk-1.
+        slack = spec_chunk if spec_chunk > 1 else 0
         # Per-sequence budget, capped so prompt(>=1) + budget + slack fits.
         budget_cap = min(steps, capacity - 1 - slack)
         if budget_cap < 1:

@@ -112,7 +112,10 @@ def _chunk_kernel(
     start = start_pos_ref[b]
     # The last chunk query attends through position start+S-1, so every page
     # up to that position must stream in; earlier queries mask the tail.
-    n_pages = pl.cdiv(start + S, page_size)
+    # Clamped to the table width: a finished row's frozen start + S may
+    # overhang its allocation by up to the chunk width (the caller reserves
+    # slack for the garbage writes, but the table has no column past Pmax).
+    n_pages = jnp.minimum(pl.cdiv(start + S, page_size), page_table_ref.shape[1])
 
     q = q_ref[0, :, 0].reshape(S * G, hd).astype(jnp.float32)
     scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))

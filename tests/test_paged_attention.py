@@ -165,6 +165,33 @@ def test_chunk_kernel_matches_chunk_reference(B, S, K, G, hd, psz, maxstart):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
 
 
+def test_chunk_kernel_clamps_overhanging_rows():
+    """A finished row's frozen start + chunk width may overhang the page
+    table by up to one chunk; the kernel must clamp its page walk to the
+    table width instead of reading page_table[b, Pmax] out of bounds
+    (regression: done rows in the speculative decode loop)."""
+    from mcpx.engine.kernels.paged_attention import (
+        paged_attention_chunk,
+        paged_attention_chunk_reference,
+    )
+
+    B, S, K, G, hd, psz, p_max = 1, 4, 1, 2, 16, 4, 3
+    n_pages = p_max + 1
+    ks = jax.random.split(jax.random.PRNGKey(9), 3)
+    q = jax.random.normal(ks[0], (B, S, K, G, hd), jnp.float32)
+    kp = jax.random.normal(ks[1], (K, n_pages, psz, hd), jnp.float32)
+    vp = jax.random.normal(ks[2], (K, n_pages, psz, hd), jnp.float32)
+    table = jnp.asarray([[1, 2, 3]], jnp.int32)
+    start = jnp.array([p_max * psz - 1], jnp.int32)  # last in-table position
+    out = paged_attention_chunk(q, kp, vp, table, start, interpret=True)
+    ref = paged_attention_chunk_reference(q, kp, vp, table, start)
+    # Query 0 is fully in-table; its output must be exact. Later queries'
+    # visible ranges overhang the table and are garbage by contract.
+    np.testing.assert_allclose(
+        np.asarray(out[:, 0]), np.asarray(ref[:, 0]), rtol=2e-5, atol=2e-5
+    )
+
+
 def test_decode_chunk_matches_sequential_steps():
     """decode_chunk_paged(S tokens) == S x decode_step_paged: same logits at
     every chunk position and identical page pools afterward (the speculation
