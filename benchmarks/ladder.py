@@ -103,18 +103,26 @@ class _Stack:
         self.session = aiohttp.ClientSession(
             connector=aiohttp.TCPConnector(limit=512)
         )
-        # Wait for background engine bring-up, then one warmup round so no
-        # XLA compile lands in the timed region.
-        while True:
-            async with self.session.get(f"{self.base}/healthz") as r:
-                h = await r.json()
-            if h.get("engine") in ("ready", "n/a"):
-                break
-            if h.get("engine") == "failed":
-                raise RuntimeError("engine failed during startup")
-            await asyncio.sleep(0.5)
-        bs = self.cp.config.engine.max_batch_size
-        await asyncio.gather(*(self.plan(f"warmup {i}") for i in range(bs)))
+        try:
+            # Wait for background engine bring-up (bounded — a wedged
+            # startup must fail the scenario, not hang the ladder), then one
+            # warmup round so no XLA compile lands in the timed region.
+            deadline = time.monotonic() + 1200
+            while True:
+                async with self.session.get(f"{self.base}/healthz") as r:
+                    h = await r.json()
+                if h.get("engine") in ("ready", "n/a"):
+                    break
+                if h.get("engine") == "failed":
+                    raise RuntimeError("engine failed during startup")
+                if time.monotonic() > deadline:
+                    raise RuntimeError("engine startup timed out")
+                await asyncio.sleep(0.5)
+            bs = self.cp.config.engine.max_batch_size
+            await asyncio.gather(*(self.plan(f"warmup {i}") for i in range(bs)))
+        except BaseException:
+            await self.__aexit__()
+            raise
         return self
 
     async def __aexit__(self, *exc):
@@ -259,5 +267,25 @@ async def main() -> None:
         await cfg(model)
 
 
+def _main_isolated() -> None:
+    """Run each config in its own subprocess: every scenario boots a fresh
+    multi-GB engine, and per-process isolation is what guarantees HBM comes
+    back between scenarios."""
+    import subprocess
+
+    only = os.environ.get("MCPX_LADDER_ONLY")
+    ids = only.split(",") if only else [str(i) for i in range(1, 6)]
+    failures = 0
+    for i in ids:
+        env = dict(os.environ, MCPX_LADDER_ONLY=i, MCPX_LADDER_CHILD="1")
+        proc = subprocess.run([sys.executable, os.path.abspath(__file__)], env=env)
+        failures += proc.returncode != 0
+    if failures:
+        raise SystemExit(f"{failures}/{len(ids)} ladder configs failed")
+
+
 if __name__ == "__main__":
-    asyncio.run(main())
+    if os.environ.get("MCPX_LADDER_CHILD"):
+        asyncio.run(main())
+    else:
+        _main_isolated()

@@ -44,7 +44,7 @@ from mcpx.engine.sampling import sample
 from mcpx.models.gemma.config import GemmaConfig
 from mcpx.models.gemma.model import init_kv_cache, prefill
 from mcpx.models.gemma.params import load_or_init
-from mcpx.models.tokenizer import ByteTokenizer
+from mcpx.models.tokenizer import make_tokenizer
 from mcpx.planner.grammar import PlanGrammar, build_plan_grammar
 from mcpx.telemetry.metrics import Metrics
 
@@ -88,10 +88,12 @@ class InferenceEngine:
     ) -> None:
         self.config = config or MCPXConfig()
         ecfg = self.config.engine
+        self.tokenizer = make_tokenizer(self.config.model.vocab)
         self.model_cfg = model_cfg or GemmaConfig.named(
-            self.config.model.size, max_seq_len=self.config.model.max_seq_len
+            self.config.model.size,
+            max_seq_len=self.config.model.max_seq_len,
+            vocab_size=self.tokenizer.vocab_size,
         )
-        self.tokenizer = ByteTokenizer()
         self.grammar: PlanGrammar = build_plan_grammar(self.tokenizer)
         self.metrics = metrics or Metrics()
         self.state = "cold"
@@ -176,6 +178,15 @@ class InferenceEngine:
         self._queue.put(None)
         if self._thread is not None:
             await asyncio.to_thread(self._thread.join, 5.0)
+        if self._thread is None or not self._thread.is_alive():
+            # Drop device buffers (weights + KV pools) so a successor engine
+            # in the same process can fit in HBM — only once the worker is
+            # actually gone (a still-running batch may hold these).
+            self._params = None
+            self._paged_kv = None
+            self._jit_prefill = None
+            self._jit_decode = None
+            self._jit_decode_spec = None
 
     # ------------------------------------------------------------------ api
     async def generate(
@@ -672,11 +683,9 @@ class InferenceEngine:
         steps = ecfg.max_decode_len
         capacity = ecfg.max_pages_per_seq * ecfg.kv_page_size
         # Grammar fast-forward speculation applies to constrained decodes
-        # only (unconstrained output has no DFA to force tokens from). The
-        # chunk's pad slots can write up to chunk-1 garbage positions past
-        # the final token, so allocations carry that much slack; on configs
-        # whose capacity can't spare it the chunk degrades toward 1
-        # (speculation is an optimisation, never a reason to fail).
+        # only (unconstrained output has no DFA to force tokens from); on
+        # configs whose capacity can't spare the slack the chunk degrades
+        # toward 1 (speculation is an optimisation, never a reason to fail).
         spec_chunk = self._spec_chunk(constrained)
         # Slack covers the chunk's garbage writes PAST a sequence's last
         # token. A row that finishes by exhausting its budget ends with

@@ -51,3 +51,72 @@ class ByteTokenizer:
         if len(b) != 1:
             raise ValueError(f"{char!r} is not a single byte")
         return b[0]
+
+    def token_bytes(self) -> list[bytes | None]:
+        """Per-id byte string each token denotes (None for specials/padding)
+        — the interface the grammar's token-DFA product compiles against."""
+        out: list[bytes | None] = [bytes([i]) for i in range(256)]
+        out += [None] * (self.vocab_size - 256)
+        return out
+
+
+class SentencePieceTokenizer:
+    """SentencePiece tokenizer for real Gemma checkpoints (vocab 256000,
+    padded to an MXU-aligned 256128). Gated: requires the ``sentencepiece``
+    package and a ``.model`` file; everything downstream (grammar product,
+    engine, planner) is tokenizer-agnostic through the same four-method
+    interface as ``ByteTokenizer`` (encode/decode/token_bytes + ids)."""
+
+    def __init__(self, model_path: str) -> None:
+        try:
+            import sentencepiece as spm
+        except ImportError as e:  # pragma: no cover - env without the lib
+            raise RuntimeError(
+                "SentencePieceTokenizer requires the 'sentencepiece' package; "
+                "use the in-tree byte tokenizer (model.vocab='byte') instead"
+            ) from e
+        self._sp = spm.SentencePieceProcessor(model_file=model_path)
+        self._raw = self._sp.vocab_size()
+        self.bos_id = self._sp.bos_id() if self._sp.bos_id() >= 0 else self._raw
+        self.eos_id = self._sp.eos_id()
+        if self.eos_id < 0:
+            raise ValueError(f"{model_path}: SentencePiece model has no EOS id")
+        # Gemma's <pad> is id 0; otherwise synthesise one in the padding tail.
+        pad = self._sp.pad_id()
+        self.pad_id = pad if pad >= 0 else self._raw + 1
+        raw_total = max(self._raw, self.bos_id + 1, self.pad_id + 1)
+        self.vocab_size = ((raw_total + _MXU_PAD - 1) // _MXU_PAD) * _MXU_PAD
+
+    def encode(self, text: str, *, bos: bool = True, eos: bool = False) -> list[int]:
+        ids = list(self._sp.encode(text))
+        if bos:
+            ids = [self.bos_id] + ids
+        if eos:
+            ids = ids + [self.eos_id]
+        return ids
+
+    def decode(self, ids) -> str:
+        return self._sp.decode([i for i in ids if 0 <= i < self._raw])
+
+    def token_bytes(self) -> list[bytes | None]:
+        out: list[bytes | None] = []
+        for i in range(self._raw):
+            if self._sp.is_control(i) or self._sp.is_unknown(i):
+                out.append(None)
+            elif self._sp.is_byte(i):
+                piece = self._sp.id_to_piece(i)  # "<0xNN>"
+                out.append(bytes([int(piece[3:-1], 16)]))
+            else:
+                out.append(self._sp.id_to_piece(i).replace("▁", " ").encode("utf-8"))
+        out += [None] * (self.vocab_size - self._raw)
+        return out
+
+
+def make_tokenizer(vocab: str = "byte"):
+    """``model.vocab`` config -> tokenizer: "byte" (in-tree, default) or
+    "sp:<path-to-model>" (SentencePiece checkpoint vocab)."""
+    if vocab in ("", "byte"):
+        return ByteTokenizer()
+    if vocab.startswith("sp:"):
+        return SentencePieceTokenizer(vocab[3:])
+    raise ValueError(f"unknown tokenizer spec {vocab!r}; expected 'byte' or 'sp:<path>'")

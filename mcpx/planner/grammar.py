@@ -1,10 +1,11 @@
-"""Byte-level DFA for grammar-constrained DAG-plan decoding.
+"""Grammar-constrained DAG-plan decoding: byte DFA × tokenizer product.
 
 The reference ``json.loads``'s raw LLM text and crashes on anything else
 (bug B7, reference ``control_plane.py:74``). Here structural validity is
-enforced *during* decoding: because the in-tree tokenizer is byte-level
-(``mcpx.models.tokenizer``), a deterministic finite automaton over bytes IS
-an automaton over tokens — so the grammar compiles to two device arrays
+enforced *during* decoding: the plan grammar is a deterministic finite
+automaton over BYTES, and for any tokenizer whose tokens denote byte
+strings (``token_bytes()``) the byte DFA lifts to a token-level DFA by
+walking each token's bytes — so the grammar compiles to two device arrays
 
   - ``transitions``: int32 ``[n_states, vocab]``  (next state per token)
   - ``mask``:        bool  ``[n_states, vocab]``  (allowed next tokens)
@@ -13,6 +14,10 @@ and the **entire constrained decode loop runs on-device** inside ``lax.scan``
 (state gather → logit mask → sample → state transition), with zero host
 round-trips per token. This is the TPU-native answer to SGLang-style
 constrained decoding (PAPERS.md): the automaton is data, not control flow.
+For the in-tree byte tokenizer the product is the identity (1 token = 1
+byte); for subword tokenizers (SentencePiece Gemma checkpoints) a token is
+legal iff its whole byte string stays inside the grammar — any tokenization
+of a valid plan is accepted.
 
 The grammar accepted is the planner wire shape (compact keys to cut decode
 length; normalised by ``Plan.from_wire``):
@@ -97,13 +102,14 @@ class _Builder:
 
 @dataclass
 class PlanGrammar:
-    transitions: np.ndarray  # [n_states, vocab] int32
+    transitions: np.ndarray  # [n_states, vocab] int32 — token-level DFA
     mask: np.ndarray  # [n_states, vocab] bool
     dist: np.ndarray  # [n_states] int32 — min samples (incl. EOS) to finish
     start_state: int
     dead_state: int
     accept_states: frozenset[int]
-    tokenizer: ByteTokenizer
+    tokenizer: "ByteTokenizer"
+    byte_transitions: np.ndarray  # [n_states, 256] int32 — underlying byte DFA
 
     @property
     def n_states(self) -> int:
@@ -118,15 +124,16 @@ class PlanGrammar:
         return state in self.accept_states
 
     def walk(self, text: str) -> int:
-        """Host-side check: run the DFA over ``text`` bytes; returns final
-        state (``dead_state`` on rejection)."""
+        """Host-side check: run the BYTE DFA over ``text``; returns final
+        state (``dead_state`` on rejection). Tokenizer-independent — a
+        decoded output is valid iff its bytes are, however it was split."""
         s = self.start_state
         for b in text.encode("utf-8"):
-            s = int(self.transitions[s, b])
+            s = int(self.byte_transitions[s, b])
         return s
 
 
-def build_plan_grammar(tokenizer: ByteTokenizer | None = None) -> PlanGrammar:
+def build_plan_grammar(tokenizer=None) -> PlanGrammar:
     tok = tokenizer or ByteTokenizer()
     g = _Builder()
 
@@ -153,22 +160,15 @@ def build_plan_grammar(tokenizer: ByteTokenizer | None = None) -> PlanGrammar:
     accept = g.literal(steps_closed, "}")
     g.eos_ok.add(accept)
 
-    # --- compile to dense tables
+    # --- dense byte tables (dead state is absorbing: all 256 entries dead)
     n = len(g.transitions) + 1  # + dead state
     dead = n - 1
-    V = tok.vocab_size
-    trans = np.full((n, V), dead, np.int32)
-    mask = np.zeros((n, V), bool)
+    byte_trans = np.full((n, 256), dead, np.int32)
     for s, edges in enumerate(g.transitions):
         for b, t in edges.items():
-            trans[s, b] = t
-            mask[s, b] = True
-    for s in g.eos_ok:
-        mask[s, tok.eos_id] = True
-        trans[s, tok.eos_id] = dead  # post-EOS state is never consulted
-    # PAD self-loops everywhere (finished sequences feed PAD; mask stays
-    # False so PAD is never *sampled* by a live sequence).
-    trans[:, tok.pad_id] = np.arange(n)
+            byte_trans[s, b] = t
+
+    trans, mask = _compile_token_tables(byte_trans, dead, g.eos_ok, tok)
     return PlanGrammar(
         transitions=trans,
         mask=mask,
@@ -177,7 +177,51 @@ def build_plan_grammar(tokenizer: ByteTokenizer | None = None) -> PlanGrammar:
         dead_state=dead,
         accept_states=frozenset(g.eos_ok),
         tokenizer=tok,
+        byte_transitions=byte_trans,
     )
+
+
+def _compile_token_tables(
+    byte_trans: np.ndarray,  # [n_states, 256], dead-absorbing
+    dead: int,
+    eos_ok: set[int],
+    tok,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Lift the byte DFA to the tokenizer's vocabulary: token t from state s
+    lands where walking t's bytes lands (product construction, vectorised
+    over the whole [n_states, vocab] matrix one byte column at a time). A
+    token is legal iff its entire byte string stays inside the grammar —
+    for the byte tokenizer this is the identity lift; for subword vocabs
+    (SentencePiece) any tokenization of a valid plan is accepted."""
+    n = byte_trans.shape[0]
+    V = tok.vocab_size
+    token_bytes = tok.token_bytes()
+    if len(token_bytes) != V:
+        raise ValueError(f"token_bytes() returned {len(token_bytes)} entries for vocab {V}")
+    nonempty = np.array([b is not None and len(b) > 0 for b in token_bytes])
+    longest = max((len(b) for b in token_bytes if b), default=1)
+    bmat = np.full((V, longest), -1, np.int32)
+    for t, b in enumerate(token_bytes):
+        if b:
+            bmat[t, : len(b)] = list(b)
+
+    state = np.tile(np.arange(n, dtype=np.int32)[:, None], (1, V))
+    for col in range(longest):
+        bc = bmat[:, col]
+        act = bc >= 0
+        if not act.any():
+            break
+        state[:, act] = byte_trans[state[:, act], bc[act]]
+    trans = state
+    trans[:, ~nonempty] = dead  # special/padding tokens never advance
+    mask = (trans != dead) & nonempty[None, :]
+    for s in eos_ok:
+        mask[s, tok.eos_id] = True
+        trans[s, tok.eos_id] = dead  # post-EOS state is never consulted
+    # PAD self-loops everywhere (finished sequences feed PAD; mask stays
+    # False so PAD is never *sampled* by a live sequence).
+    trans[:, tok.pad_id] = np.arange(n)
+    return trans, mask
 
 
 _DIST_INF = np.iinfo(np.int32).max // 2
@@ -187,37 +231,26 @@ def _distance_to_accept(
     trans: np.ndarray,
     mask: np.ndarray,
     eos_ok: set[int],
-    tok: ByteTokenizer,
+    tok,
     dead: int,
 ) -> np.ndarray:
     """``dist[s]`` = fewest sampled tokens to *finish* from state ``s``
-    (counting the final EOS sample). Multi-source reverse BFS: accept states
-    start at 1 (one EOS sample away); every byte edge adds 1. The decode loop
-    uses this to force the JSON closed before the token budget runs out —
-    so a budget-bounded constrained decode can never be truncated mid-plan.
-    """
+    (counting the final EOS sample). Value iteration to fixpoint over the
+    token-level graph (tokens may span several bytes, so this is shortest
+    path in SAMPLES, which is what the decode budget counts). The decode
+    loop uses this to force the JSON closed before the token budget runs
+    out — a budget-bounded constrained decode is never truncated mid-plan."""
     n = trans.shape[0]
+    gen = mask.copy()
+    gen[:, tok.eos_id] = False
+    gen[:, tok.pad_id] = False
     dist = np.full((n,), _DIST_INF, np.int64)
-    # Reverse adjacency over real byte edges (PAD self-loops and the
-    # post-EOS edge into `dead` are not generative moves).
-    preds: list[list[int]] = [[] for _ in range(n)]
-    for s in range(n):
-        for b in np.nonzero(mask[s])[0]:
-            if b == tok.eos_id or b == tok.pad_id:
-                continue
-            t = int(trans[s, b])
-            if t != dead:
-                preds[t].append(s)
-    frontier = sorted(eos_ok)
-    for s in frontier:
+    for s in eos_ok:
         dist[s] = 1
-    while frontier:
-        nxt: list[int] = []
-        for t in frontier:
-            d = dist[t] + 1
-            for s in preds[t]:
-                if d < dist[s]:
-                    dist[s] = d
-                    nxt.append(s)
-        frontier = nxt
-    return dist.astype(np.int32)
+    for _ in range(n + 1):
+        succ = np.where(gen, dist[trans], _DIST_INF)  # [n, V]
+        nd = np.minimum(dist, succ.min(axis=1) + 1)
+        if np.array_equal(nd, dist):
+            break
+        dist = nd
+    return np.minimum(dist, _DIST_INF).astype(np.int32)

@@ -167,3 +167,116 @@ def test_budget_mask_never_strands():
                 assert emitted < budget, "budget exceeded without EOS"
             decoded = tok.decode(text)
             assert g.is_accept(g.walk(decoded)), decoded
+
+
+class ToySubwordTokenizer:
+    """Synthetic multi-byte tokenizer (SentencePiece stand-in): all single
+    bytes plus merged JSON-structure fragments and service-name pieces —
+    exercises the grammar's token-DFA product without external model files."""
+
+    MERGES = [b'{"steps":[{"s":"', b'","in":[', b'"],"next":[', b'"]}',
+              b'auth', b'fetch', b'-00', b'"]},{"s":"', b'{"s":"', b'": "', b'xyz']
+
+    def __init__(self):
+        self._pieces = [bytes([i]) for i in range(256)] + list(self.MERGES)
+        self.pad_id = len(self._pieces)
+        self.bos_id = self.pad_id + 1
+        self.eos_id = self.pad_id + 2
+        raw = self.eos_id + 1
+        self.vocab_size = ((raw + 127) // 128) * 128
+
+    def token_bytes(self):
+        out = list(self._pieces)
+        out += [None] * (self.vocab_size - len(out))
+        return out
+
+    def encode(self, text, *, bos=True, eos=False):
+        data = text.encode("utf-8")
+        ids, i = ([self.bos_id] if bos else []), 0
+        by_len = sorted(range(256, len(self._pieces)), key=lambda t: -len(self._pieces[t]))
+        while i < len(data):
+            for t in by_len:
+                p = self._pieces[t]
+                if data.startswith(p, i):
+                    ids.append(t)
+                    i += len(p)
+                    break
+            else:
+                ids.append(data[i])
+                i += 1
+        return ids + ([self.eos_id] if eos else [])
+
+    def decode(self, ids):
+        return b"".join(self._pieces[i] for i in ids if 0 <= i < len(self._pieces)).decode(
+            "utf-8", errors="replace"
+        )
+
+
+def test_subword_product_matches_byte_walk():
+    """Token-level transitions == walking each token's bytes through the
+    byte DFA, for every (state, token)."""
+    tok = ToySubwordTokenizer()
+    g = build_plan_grammar(tok)
+    tb = tok.token_bytes()
+    rng = np.random.default_rng(0)
+    states = rng.integers(0, g.n_states, size=40)
+    tokens = list(rng.integers(0, tok.vocab_size, size=60)) + [256, 257, 258, 259, 263]
+    for s in states:
+        for t in tokens:
+            b = tb[t]
+            if t in (tok.eos_id, tok.pad_id) or b is None or not b:
+                continue
+            expect = int(s)
+            for byte in b:
+                expect = int(g.byte_transitions[expect, byte])
+            assert int(g.transitions[s, t]) == expect, (s, t, b)
+            assert bool(g.mask[s, t]) == (expect != g.dead_state)
+
+
+def test_subword_constrained_walk_emits_valid_json():
+    """A constrained greedy walk over the SUBWORD vocab must emit bytes the
+    grammar accepts — multi-byte fragments included — and round-trip
+    through Plan.from_json."""
+    import json as _json
+    import random
+
+    tok = ToySubwordTokenizer()
+    g = build_plan_grammar(tok)
+    rng = random.Random(5)
+    for trial in range(10):
+        state, ids, emitted = g.start_state, [], 0
+        budget = 96
+        while True:
+            rem = budget - emitted - 1
+            allowed = [
+                int(t)
+                for t in np.flatnonzero(g.mask[state])
+                if t == tok.eos_id or int(g.dist[int(g.transitions[state, t])]) <= rem
+            ]
+            assert allowed, f"stranded at {state}"
+            t = rng.choice(allowed)
+            emitted += 1
+            if t == tok.eos_id:
+                break
+            ids.append(t)
+            state = int(g.transitions[state, t])
+        decoded = tok.decode(ids)
+        assert g.is_accept(g.walk(decoded)), decoded
+        _json.loads(decoded)
+
+
+def test_subword_dist_counts_samples_not_bytes():
+    """min_len over a subword vocab must be <= the byte vocab's min_len:
+    merged fragments cover several bytes per sample."""
+    byte_g = build_plan_grammar(ByteTokenizer())
+    sub_g = build_plan_grammar(ToySubwordTokenizer())
+    assert sub_g.min_len <= byte_g.min_len
+    assert sub_g.min_len >= 4  # still needs items + closes + EOS
+
+
+def test_byte_tokenizer_product_is_identity_lift():
+    """For the byte tokenizer the token DFA must equal the byte DFA on byte
+    ids (the product is the identity lift)."""
+    tok = ByteTokenizer()
+    g = build_plan_grammar(tok)
+    np.testing.assert_array_equal(g.transitions[:, :256], g.byte_transitions)
