@@ -57,7 +57,9 @@ def _build_config(model_size: str):
                 # One constrained decode per plan; validation failures repair
                 # via the heuristic (worst-case cost path for random weights).
                 "max_plan_retries": 0,
-                "shortlist_top_k": 8,
+                # 6-way shortlist keeps the compact prompt inside the
+                # 768-token prefill bucket (8-way spills into 1024).
+                "shortlist_top_k": 6,
             },
         }
     )

@@ -257,11 +257,13 @@ async def config5(model: str) -> None:
               len(intents) / dt, "req/s", ok=ok, total=len(intents))
 
 
+CONFIGS = [config1, config2, config3, config4, config5]
+
+
 async def main() -> None:
     model = os.environ.get("MCPX_BENCH_MODEL") or ("2b" if _on_tpu() else "test")
     only = os.environ.get("MCPX_LADDER_ONLY")
-    configs = [config1, config2, config3, config4, config5]
-    for i, cfg in enumerate(configs, start=1):
+    for i, cfg in enumerate(CONFIGS, start=1):
         if only and str(i) not in only.split(","):
             continue
         await cfg(model)
@@ -274,7 +276,7 @@ def _main_isolated() -> None:
     import subprocess
 
     only = os.environ.get("MCPX_LADDER_ONLY")
-    ids = only.split(",") if only else [str(i) for i in range(1, 6)]
+    ids = only.split(",") if only else [str(i) for i in range(1, len(CONFIGS) + 1)]
     failures = 0
     for i in ids:
         env = dict(os.environ, MCPX_LADDER_ONLY=i, MCPX_LADDER_CHILD="1")

@@ -116,7 +116,7 @@ class InferenceEngine:
         )
         self._prefill_buckets = tuple(
             b
-            for b in (64, 128, 256, 512, 1024, 2048)
+            for b in (64, 128, 256, 512, 768, 1024, 1536, 2048)
             if b <= self.model_cfg.max_seq_len and b % ecfg.kv_page_size == 0
         )
         if not self._prefill_buckets:

@@ -1,8 +1,10 @@
 """Ragged paged-attention decode kernel (Pallas TPU) + jnp reference.
 
 One decode step of attention for a batch of sequences whose KV lives in a
-shared page pool (``mcpx.engine.kv_cache`` layout: kv-head-major
-``[K, N_pages, page_size, head_dim]`` per layer). Grid is ``(B, K)``; each
+shared page pool (``mcpx.engine.kv_cache`` layout: kv-head-major, all
+layers in one array — ``[K, L, N_pages, page_size, head_dim]``; the kernel
+streams one layer's slice selected by a prefetched scalar, so the decode
+loop can carry the pools through ``lax.scan``). Grid is ``(B, K)``; each
 program DMAs its sequence's pages HBM→VMEM one at a time and accumulates
 flash-style (online softmax in fp32), so
   - no ``[B, S_max]`` dense cache is ever materialised (ragged batches share
@@ -33,18 +35,19 @@ NEG_INF = -1e30
 # ---------------------------------------------------------------- reference
 def paged_attention_reference(
     q: jax.Array,  # [B, K, G, hd]
-    k_pages: jax.Array,  # [K, N, Psz, hd]
-    v_pages: jax.Array,  # [K, N, Psz, hd]
+    k_pages: jax.Array,  # [K, L, N, Psz, hd] — all layers
+    v_pages: jax.Array,
     page_table: jax.Array,  # [B, Pmax] int32
     seq_lens: jax.Array,  # [B] int32 (tokens valid in cache, incl. current)
+    layer: jax.Array | int = 0,
 ) -> jax.Array:
     """Pure-jnp semantics reference; returns [B, K, G, hd] in q.dtype."""
     B, K, G, hd = q.shape
-    _, _, psz, _ = k_pages.shape
+    _, _, _, psz, _ = k_pages.shape
     p_max = page_table.shape[1]
     # Gather pages: [B, K, Pmax*Psz, hd]
-    k = k_pages[:, page_table].transpose(1, 0, 2, 3, 4).reshape(B, K, p_max * psz, hd)
-    v = v_pages[:, page_table].transpose(1, 0, 2, 3, 4).reshape(B, K, p_max * psz, hd)
+    k = k_pages[:, layer][:, page_table].transpose(1, 0, 2, 3, 4).reshape(B, K, p_max * psz, hd)
+    v = v_pages[:, layer][:, page_table].transpose(1, 0, 2, 3, 4).reshape(B, K, p_max * psz, hd)
     scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
     logits = jnp.einsum("bkgh,bksh->bkgs", q, k, preferred_element_type=jnp.float32)
     logits = logits * scale
@@ -58,10 +61,11 @@ def paged_attention_reference(
 
 def paged_attention_chunk_reference(
     q: jax.Array,  # [B, S, K, G, hd] — S new queries per sequence
-    k_pages: jax.Array,  # [K, N, Psz, hd]
+    k_pages: jax.Array,  # [K, L, N, Psz, hd] — all layers
     v_pages: jax.Array,
     page_table: jax.Array,  # [B, Pmax] int32
     start_pos: jax.Array,  # [B] int32 — cache position of query 0
+    layer: jax.Array | int = 0,
 ) -> jax.Array:
     """Chunked decode attention, pure jnp: query i of sequence b attends
     through cache position ``start_pos[b]+i`` (itself + earlier chunk
@@ -71,11 +75,11 @@ def paged_attention_chunk_reference(
     the HBM traffic of this formulation (the dominant cost of jnp-path
     decode). Returns [B, S, K, G, hd] in q.dtype."""
     B, S, K, G, hd = q.shape
-    _, _, psz, _ = k_pages.shape
+    _, _, _, psz, _ = k_pages.shape
     p_max = page_table.shape[1]
     L = p_max * psz
-    k = k_pages[:, page_table].transpose(1, 0, 2, 3, 4).reshape(B, K, L, hd)
-    v = v_pages[:, page_table].transpose(1, 0, 2, 3, 4).reshape(B, K, L, hd)
+    k = k_pages[:, layer][:, page_table].transpose(1, 0, 2, 3, 4).reshape(B, K, L, hd)
+    v = v_pages[:, layer][:, page_table].transpose(1, 0, 2, 3, 4).reshape(B, K, L, hd)
     scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
     logits = jnp.einsum("bskgh,bklh->bskgl", q, k, preferred_element_type=jnp.float32)
     logits = logits * scale
@@ -92,9 +96,10 @@ def _chunk_kernel(
     # scalar prefetch
     page_table_ref,  # [B, Pmax] SMEM
     start_pos_ref,  # [B] SMEM
+    layer_ref,  # [1] SMEM — which layer's pool slice to stream
     # blocks
     q_ref,  # [1, S, 1, G, hd] VMEM
-    k_pages_ref,  # [K, N, Psz, hd] ANY (stays in HBM)
+    k_pages_ref,  # [K, L, N, Psz, hd] ANY (stays in HBM)
     v_pages_ref,
     out_ref,  # [1, S, 1, G, hd] VMEM
     # scratch
@@ -108,6 +113,7 @@ def _chunk_kernel(
 ):
     b = pl.program_id(0)
     kh = pl.program_id(1)
+    layer = layer_ref[0]
     S, G, hd = q_ref.shape[1], q_ref.shape[3], q_ref.shape[4]
     start = start_pos_ref[b]
     # The last chunk query attends through position start+S-1, so every page
@@ -125,12 +131,16 @@ def _chunk_kernel(
 
     def dma_k(slot, page_idx):
         return pltpu.make_async_copy(
-            k_pages_ref.at[kh, page_table_ref[b, page_idx]], k_buf.at[slot], sem_k.at[slot]
+            k_pages_ref.at[kh, layer, page_table_ref[b, page_idx]],
+            k_buf.at[slot],
+            sem_k.at[slot],
         )
 
     def dma_v(slot, page_idx):
         return pltpu.make_async_copy(
-            v_pages_ref.at[kh, page_table_ref[b, page_idx]], v_buf.at[slot], sem_v.at[slot]
+            v_pages_ref.at[kh, layer, page_table_ref[b, page_idx]],
+            v_buf.at[slot],
+            sem_v.at[slot],
         )
 
     # Fill the pipeline: up to n_buf DMAs in flight hides per-transfer
@@ -185,10 +195,11 @@ def _chunk_kernel(
 @functools.partial(jax.jit, static_argnames=("interpret", "n_buf"))
 def paged_attention_chunk(
     q: jax.Array,  # [B, S, K, G, hd]
-    k_pages: jax.Array,  # [K, N, Psz, hd]
+    k_pages: jax.Array,  # [K, L, N, Psz, hd] — all layers (stays in HBM)
     v_pages: jax.Array,
     page_table: jax.Array,  # [B, Pmax]
     start_pos: jax.Array,  # [B] — cache position of query 0
+    layer: jax.Array | int = 0,
     *,
     interpret: bool = False,
     n_buf: int = 4,
@@ -196,12 +207,15 @@ def paged_attention_chunk(
     """Chunked-decode Pallas kernel: grid (B, K); ONE program streams a
     sequence's pages once for all S chunk queries ([S*G, hd] MXU rows/page
     vs [G, hd] for the single-query kernel folded over B*S programs — S
-    times fewer DMA issues, S*G-row matmuls instead of G-row)."""
+    times fewer DMA issues, S*G-row matmuls instead of G-row). The pools
+    hold every layer ([K, L, ...]) so the decode loop can carry them
+    through lax.scan and the kernel streams just ``layer``'s slice —
+    slicing host-side would materialise a per-layer copy."""
     B, S, K, G, hd = q.shape
-    _, _, page_size, _ = k_pages.shape
+    _, _, _, page_size, _ = k_pages.shape
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3,
         grid=(B, K),
         in_specs=[
             pl.BlockSpec(
@@ -226,16 +240,24 @@ def paged_attention_chunk(
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         interpret=interpret,
-    )(page_table.astype(jnp.int32), start_pos.astype(jnp.int32), q, k_pages, v_pages)
+    )(
+        page_table.astype(jnp.int32),
+        start_pos.astype(jnp.int32),
+        jnp.asarray(layer, jnp.int32).reshape(1),
+        q,
+        k_pages,
+        v_pages,
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def paged_attention(
     q: jax.Array,  # [B, K, G, hd]
-    k_pages: jax.Array,  # [K, N, Psz, hd]
+    k_pages: jax.Array,  # [K, L, N, Psz, hd] — all layers
     v_pages: jax.Array,
     page_table: jax.Array,  # [B, Pmax]
     seq_lens: jax.Array,  # [B]
+    layer: jax.Array | int = 0,
     *,
     interpret: bool = False,
 ) -> jax.Array:
@@ -243,6 +265,6 @@ def paged_attention(
     (ONE streaming-softmax kernel to maintain; ``seq_lens`` counts the
     just-written token, so the chunk's start position is ``seq_lens-1``)."""
     out = paged_attention_chunk(
-        q[:, None], k_pages, v_pages, page_table, seq_lens - 1, interpret=interpret
+        q[:, None], k_pages, v_pages, page_table, seq_lens - 1, layer, interpret=interpret
     )
     return out[:, 0]

@@ -31,7 +31,7 @@ def decode_chunk_paged(
     tokens: jax.Array,  # [B, S] int32 — chunk of new tokens per sequence
     positions: jax.Array,  # [B] int32 — slot tokens[:, 0] is written to
     page_table: jax.Array,  # [B, Pmax] int32
-    paged_kv: dict[str, jax.Array],  # k/v: [L, K, N, Psz, hd]
+    paged_kv: dict[str, jax.Array],  # k/v: [K, L, N, Psz, hd]
     *,
     use_pallas: bool = True,
     interpret: bool = False,
@@ -43,11 +43,10 @@ def decode_chunk_paged(
     from the plan DFA need no sampling, only KV population and the logits
     at the chain end — so S sequential decode steps collapse into one
     forward whose per-token cost is amortised over the weight loads that
-    dominate decode on TPU. Query i of a sequence attends to the paged
-    cache through position ``positions+i`` (itself and earlier chunk
-    tokens included, written to the pools first); the attention itself is
-    the existing ragged paged kernel with the chunk folded into the batch
-    dimension ([B, S] → [B*S] queries, per-query seq_lens).
+    dominate decode on TPU. The pools ([K, L, N, Psz, hd], all layers) are
+    carried through the layer scan; each layer writes its chunk K/V with
+    one flat scatter, then the chunk kernel streams that layer's pages
+    once for all S queries (query i sees cache through ``positions+i``).
 
     Tokens past a sequence's valid chain are pads; their K/V slots hold
     garbage that the next chunk (which starts at the first invalid
@@ -55,53 +54,65 @@ def decode_chunk_paged(
     Returns ([B, S, V] logits, pools).
     """
     B, S = tokens.shape
-    psz = paged_kv["k"].shape[3]
+    K, L, N, psz, hd = paged_kv["k"].shape
     x = params["embed"][tokens].astype(jnp.dtype(cfg.dtype))  # [B, S, D]
     x = x * jnp.asarray(math.sqrt(cfg.d_model), x.dtype)
 
     pos_mat = positions[:, None] + jnp.arange(S, dtype=positions.dtype)  # [B, S]
-    pages = jnp.take_along_axis(page_table, pos_mat // psz, axis=1)  # [B, S]
-    slots = pos_mat % psz  # [B, S]
+    # Flat token-slot index into the [K, L, N*psz, hd] pool view: ONE
+    # single-advanced-index scatter per layer into the scan CARRY (measured
+    # ~3x cheaper on v5e than scattering per-layer slices through scan
+    # xs/ys, which copies whole pool slices).
+    flat_idx = jnp.take_along_axis(page_table, pos_mat // psz, axis=1) * psz + pos_mat % psz
 
-    def attend(q, k_pool, v_pool):
+    def attend(q, k_all, v_all, layer):
         # Both paths stream/gather each sequence's pages ONCE for all S
         # chunk queries (folding the chunk into the batch dim instead would
         # multiply page traffic by S — the dominant decode cost).
         qg = q.reshape(B, S, cfg.n_kv_heads, cfg.q_per_kv, cfg.head_dim)
         if use_pallas:
             out = paged_attention_chunk(
-                qg, k_pool, v_pool, page_table, positions, interpret=interpret
+                qg, k_all, v_all, page_table, positions, layer, interpret=interpret
             )
         else:
-            out = paged_attention_chunk_reference(qg, k_pool, v_pool, page_table, positions)
+            out = paged_attention_chunk_reference(
+                qg, k_all, v_all, page_table, positions, layer
+            )
         return out.reshape(B, S, cfg.n_heads * cfg.head_dim)
 
-    def body(carry, scanned):
-        x = carry  # [B, S, D]
-        lp, k_pool, v_pool = scanned  # pools: [K, N, Psz, hd]
+    def body(carry, lp):
+        x, k_all, v_all, layer = carry  # pools: [K, L, N, Psz, hd]
         h = rms_norm(x, lp["pre_attn_norm"], cfg.norm_eps)
         q = jnp.einsum("bsd,dkh->bskh", h, lp["wq"])  # [B, S, H, hd]
         k = jnp.einsum("bsd,dkh->bskh", h, lp["wk"])  # [B, S, K, hd]
         v = jnp.einsum("bsd,dkh->bskh", h, lp["wv"])
         q = apply_rope(q, pos_mat, cfg.rope_theta)
         k = apply_rope(k, pos_mat, cfg.rope_theta)
-        k_pool = k_pool.at[:, pages, slots].set(
-            k.transpose(2, 0, 1, 3).astype(k_pool.dtype)
+        k_all = (
+            k_all.reshape(K, L, N * psz, hd)
+            .at[:, layer, flat_idx]
+            .set(k.transpose(2, 0, 1, 3).astype(k_all.dtype))
+            .reshape(K, L, N, psz, hd)
         )
-        v_pool = v_pool.at[:, pages, slots].set(
-            v.transpose(2, 0, 1, 3).astype(v_pool.dtype)
+        v_all = (
+            v_all.reshape(K, L, N * psz, hd)
+            .at[:, layer, flat_idx]
+            .set(v.transpose(2, 0, 1, 3).astype(v_all.dtype))
+            .reshape(K, L, N, psz, hd)
         )
-        attn = attend(q, k_pool, v_pool)
+        attn = attend(q, k_all, v_all, layer)
         wo = lp["wo"].reshape(cfg.n_heads * cfg.head_dim, cfg.d_model)
         x = x + jnp.einsum("bsf,fd->bsd", attn, wo)
         h = rms_norm(x, lp["pre_mlp_norm"], cfg.norm_eps)
         ff = jax.nn.gelu(jnp.einsum("bsd,df->bsf", h, lp["w_gate"]), approximate=True)
         ff = ff * jnp.einsum("bsd,df->bsf", h, lp["w_up"])
         x = x + jnp.einsum("bsf,fd->bsd", ff, lp["w_down"])
-        return x, (k_pool, v_pool)
+        return (x, k_all, v_all, layer + 1), None
 
-    x, (k_new, v_new) = lax.scan(
-        body, x, (params["layers"], paged_kv["k"], paged_kv["v"])
+    (x, k_new, v_new, _), _ = lax.scan(
+        body,
+        (x, paged_kv["k"], paged_kv["v"], jnp.asarray(0, jnp.int32)),
+        params["layers"],
     )
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = jnp.einsum("bsd,vd->bsv", x, params["embed"], preferred_element_type=jnp.float32)
@@ -114,7 +125,7 @@ def decode_step_paged(
     tokens: jax.Array,  # [B] int32
     positions: jax.Array,  # [B] int32 — slot this token is written to
     page_table: jax.Array,  # [B, Pmax] int32
-    paged_kv: dict[str, jax.Array],  # k/v: [L, K, N, Psz, hd]
+    paged_kv: dict[str, jax.Array],  # k/v: [K, L, N, Psz, hd]
     *,
     use_pallas: bool = True,
     interpret: bool = False,

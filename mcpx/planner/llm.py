@@ -125,8 +125,8 @@ class LLMPlanner:
         """Compact prompt: shortlist + telemetry features + intent, trimmed to
         ``max_prompt_tokens`` (byte tokenizer: 1 token ≈ 1 char)."""
         lines = [
-            "Compose microservices into a DAG for the intent.",
-            'Reply with JSON {"steps":[{"s":svc,"in":[keys],"next":[svcs]}]}.',
+            'Compose a service DAG for the intent. '
+            'JSON: {"steps":[{"s":svc,"in":[keys],"next":[svcs]}]}',
             "Services:",
         ]
         for s in services:
@@ -137,7 +137,14 @@ class LLMPlanner:
             cost = s.cost_profile.get("cost")
             if cost is not None:
                 feat += f" cost={cost:g}"
-            lines.append(f"- {s.schema_text()}{feat}")
+            # Compact per-service line — name, io keys, tags, live features.
+            # The prose description stays out of the PROMPT (it feeds the
+            # retrieval embedder instead): with a byte tokenizer every char
+            # is a prefill token, and dropping descriptions moves an 8-way
+            # shortlist from the 1024-token prefill bucket into 768.
+            ins = ",".join(sorted(s.input_schema))
+            outs = ",".join(sorted(s.output_schema))
+            lines.append(f"- {s.name} in({ins}) out({outs}) {' '.join(s.tags)}{feat}")
         lines.append(f"Intent: {intent}")
         lines.append("JSON:")
         text = "\n".join(lines)
@@ -145,7 +152,7 @@ class LLMPlanner:
         if len(text) > budget:
             # Drop whole service lines from the tail of the list (lowest
             # retrieval rank) until the prompt fits; intent always survives.
-            head, tail = lines[:3], lines[3:-2]
+            head, tail = lines[:2], lines[2:-2]
             fixed = len("\n".join(head)) + len("\n".join(lines[-2:])) + 2
             kept: list[str] = []
             for line in tail:

@@ -130,7 +130,7 @@ def test_prompt_contains_telemetry_and_respects_shortlist_and_budget():
         assert "cost=2" in prompt
         # Shortlisted services only, in retrieval order.
         assert "summarize" in prompt and "- f3" not in prompt
-        assert prompt.index("summarize |") < prompt.index("fetch |")
+        assert prompt.index("- summarize ") < prompt.index("- fetch ")
         assert prompt.rstrip().endswith("JSON:")
         assert "fetch and summarize" in prompt
 

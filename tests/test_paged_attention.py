@@ -24,8 +24,8 @@ def make_case(key, B, K, G, hd, psz, p_max, n_pages, max_len):
     """Random q/pages/page_table/seq_lens with ragged lengths."""
     ks = jax.random.split(key, 4)
     q = jax.random.normal(ks[0], (B, K, G, hd), jnp.float32)
-    k_pages = jax.random.normal(ks[1], (K, n_pages, psz, hd), jnp.float32)
-    v_pages = jax.random.normal(ks[2], (K, n_pages, psz, hd), jnp.float32)
+    k_pages = jax.random.normal(ks[1], (K, 2, n_pages, psz, hd), jnp.float32)
+    v_pages = jax.random.normal(ks[2], (K, 2, n_pages, psz, hd), jnp.float32)
     rng = random.Random(int(jax.random.randint(ks[3], (), 0, 2**31 - 1)))
     seq_lens = [rng.randint(1, max_len) for _ in range(B)]
     table = np.zeros((B, p_max), np.int32)
@@ -53,8 +53,9 @@ def test_kernel_matches_reference(B, K, G, hd, psz, maxlen):
     q, kp, vp, table, lens = make_case(
         jax.random.PRNGKey(B * 100 + K), B, K, G, hd, psz, p_max, n_pages, maxlen
     )
-    ref = paged_attention_reference(q, kp, vp, table, lens)
-    out = paged_attention(q, kp, vp, table, lens, interpret=True)
+    # layer=1 exercises the prefetched layer-slice selection.
+    ref = paged_attention_reference(q, kp, vp, table, lens, layer=1)
+    out = paged_attention(q, kp, vp, table, lens, 1, interpret=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
 
 
@@ -66,8 +67,8 @@ def test_reference_matches_dense_attention():
     q, kp, vp, table, _ = make_case(key, B, K, G, hd, psz, 4, 8, S)
     lens = jnp.array([S])
     # Dense K/V from the pages the table points to.
-    k = kp[:, np.asarray(table[0])].reshape(K, -1, hd)[:, :S]
-    v = vp[:, np.asarray(table[0])].reshape(K, -1, hd)[:, :S]
+    k = kp[:, 0][:, np.asarray(table[0])].reshape(K, -1, hd)[:, :S]
+    v = vp[:, 0][:, np.asarray(table[0])].reshape(K, -1, hd)[:, :S]
     logits = jnp.einsum("kgh,ksh->kgs", q[0], k) / np.sqrt(hd)
     dense = jnp.einsum("kgs,ksh->kgh", jax.nn.softmax(logits, -1), v)
     ref = paged_attention_reference(q, kp, vp, table, lens)
@@ -87,11 +88,11 @@ def test_commit_and_decode_write_roundtrip():
     paged = commit_prefill_to_pages(paged, dense, table, seq_lens, psz)
     # Page 1 holds seq0 chunk0, page 2 chunk1.
     np.testing.assert_allclose(
-        np.asarray(paged["k"][0, :, 1]),  # [K, psz, hd]
+        np.asarray(paged["k"][:, 0, 1]),  # [K, psz, hd]
         np.asarray(dense["k"][0, 0, :psz].transpose(1, 0, 2)),
     )
     np.testing.assert_allclose(
-        np.asarray(paged["k"][1, :, 4]),
+        np.asarray(paged["k"][:, 1, 4]),
         np.asarray(dense["k"][1, 1, psz:].transpose(1, 0, 2)),
     )
     # Decode write at position 5 for seq1 -> page 4 slot 1.
@@ -99,7 +100,7 @@ def test_commit_and_decode_write_roundtrip():
     v_new = jax.random.normal(jax.random.PRNGKey(4), (2, B, 2, 16))
     paged = write_decode_kv(paged, k_new, v_new, table, jnp.array([8 % (psz * 4), 5]))
     np.testing.assert_allclose(
-        np.asarray(paged["k"][0, :, 4, 1]), np.asarray(k_new[0, 1])
+        np.asarray(paged["k"][:, 0, 4, 1]), np.asarray(k_new[0, 1])
     )
 
 
@@ -112,8 +113,8 @@ def test_chunk_reference_matches_per_query_fold():
     n_pages = B * p_max + 1
     ks = jax.random.split(jax.random.PRNGKey(5), 3)
     q = jax.random.normal(ks[0], (B, S, K, G, hd), jnp.float32)
-    kp = jax.random.normal(ks[1], (K, n_pages, psz, hd), jnp.float32)
-    vp = jax.random.normal(ks[2], (K, n_pages, psz, hd), jnp.float32)
+    kp = jax.random.normal(ks[1], (K, 2, n_pages, psz, hd), jnp.float32)
+    vp = jax.random.normal(ks[2], (K, 2, n_pages, psz, hd), jnp.float32)
     table = jnp.asarray(np.arange(B * p_max, dtype=np.int32).reshape(B, p_max) + 1)
     start = jnp.array([2, 9], jnp.int32)
 
@@ -148,8 +149,8 @@ def test_chunk_kernel_matches_chunk_reference(B, S, K, G, hd, psz, maxstart):
     n_pages = B * p_max + 2
     ks = jax.random.split(jax.random.PRNGKey(B * 10 + S), 4)
     q = jax.random.normal(ks[0], (B, S, K, G, hd), jnp.float32)
-    kp = jax.random.normal(ks[1], (K, n_pages, psz, hd), jnp.float32)
-    vp = jax.random.normal(ks[2], (K, n_pages, psz, hd), jnp.float32)
+    kp = jax.random.normal(ks[1], (K, 2, n_pages, psz, hd), jnp.float32)
+    vp = jax.random.normal(ks[2], (K, 2, n_pages, psz, hd), jnp.float32)
     rng = random.Random(7)
     starts = jnp.asarray([rng.randint(0, maxstart) for _ in range(B)], jnp.int32)
     table = np.zeros((B, p_max), np.int32)
@@ -160,8 +161,8 @@ def test_chunk_kernel_matches_chunk_reference(B, S, K, G, hd, psz, maxstart):
             used.add(p)
             table[b, i] = p
     table = jnp.asarray(table)
-    ref = paged_attention_chunk_reference(q, kp, vp, table, starts)
-    out = paged_attention_chunk(q, kp, vp, table, starts, interpret=True)
+    ref = paged_attention_chunk_reference(q, kp, vp, table, starts, layer=1)
+    out = paged_attention_chunk(q, kp, vp, table, starts, 1, interpret=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
 
 
@@ -179,8 +180,8 @@ def test_chunk_kernel_clamps_overhanging_rows():
     n_pages = p_max + 1
     ks = jax.random.split(jax.random.PRNGKey(9), 3)
     q = jax.random.normal(ks[0], (B, S, K, G, hd), jnp.float32)
-    kp = jax.random.normal(ks[1], (K, n_pages, psz, hd), jnp.float32)
-    vp = jax.random.normal(ks[2], (K, n_pages, psz, hd), jnp.float32)
+    kp = jax.random.normal(ks[1], (K, 2, n_pages, psz, hd), jnp.float32)
+    vp = jax.random.normal(ks[2], (K, 2, n_pages, psz, hd), jnp.float32)
     table = jnp.asarray([[1, 2, 3]], jnp.int32)
     start = jnp.array([p_max * psz - 1], jnp.int32)  # last in-table position
     out = paged_attention_chunk(q, kp, vp, table, start, interpret=True)
@@ -207,10 +208,10 @@ def test_decode_chunk_matches_sequential_steps():
     params = init_params(cfg, jax.random.PRNGKey(0))
     pool0 = {
         "k": jax.random.normal(
-            jax.random.PRNGKey(1), (cfg.n_layers, cfg.n_kv_heads, n_pages, psz, cfg.head_dim)
+            jax.random.PRNGKey(1), (cfg.n_kv_heads, cfg.n_layers, n_pages, psz, cfg.head_dim)
         ),
         "v": jax.random.normal(
-            jax.random.PRNGKey(2), (cfg.n_layers, cfg.n_kv_heads, n_pages, psz, cfg.head_dim)
+            jax.random.PRNGKey(2), (cfg.n_kv_heads, cfg.n_layers, n_pages, psz, cfg.head_dim)
         ),
     }
     table = jnp.asarray(np.arange(B * p_max, dtype=np.int32).reshape(B, p_max) + 1)
@@ -251,10 +252,10 @@ def test_decode_chunk_pallas_interpret_matches_reference_path():
     params = init_params(cfg, jax.random.PRNGKey(0))
     pool0 = {
         "k": jax.random.normal(
-            jax.random.PRNGKey(1), (cfg.n_layers, cfg.n_kv_heads, n_pages, psz, cfg.head_dim)
+            jax.random.PRNGKey(1), (cfg.n_kv_heads, cfg.n_layers, n_pages, psz, cfg.head_dim)
         ),
         "v": jax.random.normal(
-            jax.random.PRNGKey(2), (cfg.n_layers, cfg.n_kv_heads, n_pages, psz, cfg.head_dim)
+            jax.random.PRNGKey(2), (cfg.n_kv_heads, cfg.n_layers, n_pages, psz, cfg.head_dim)
         ),
     }
     table = jnp.asarray(np.arange(B * p_max, dtype=np.int32).reshape(B, p_max) + 1)
