@@ -4,27 +4,22 @@ This is the TPU-world analogue of "test multi-node without a cluster"
 (SURVEY.md §4.3): sharding specs, TP decode and collective layouts are
 exercised on 8 virtual CPU devices; real-TPU execution is covered by the
 driver's bench run.
+
+The arming recipe (env flags + jax config + backend reset when a
+sitecustomize already latched the real TPU) lives in one place —
+``__graft_entry__._force_virtual_cpu`` — shared with the driver's
+multichip dryrun so the two can't drift.
 """
 
 import os
 
-# Force CPU unconditionally: the session env points JAX at a live TPU
-# (platform "axon", registered by a sitecustomize that imports jax at
-# interpreter start, so env vars alone are latched too late). Unit tests
-# must be deterministic, fast, and use full-f32 matmuls (TPU defaults
-# matmul inputs to bf16), so override via jax.config after import.
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = [
-    f
-    for f in os.environ.get("XLA_FLAGS", "").split()
-    if "xla_force_host_platform_device_count" not in f
-]
-_flags.append("--xla_force_host_platform_device_count=8")
-os.environ["XLA_FLAGS"] = " ".join(_flags)
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+from __graft_entry__ import _force_virtual_cpu  # noqa: E402
+
+_force_virtual_cpu(8)
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
 assert jax.default_backend() == "cpu", "tests must run on CPU"
 assert len(jax.devices()) == 8, "tests expect an 8-device virtual CPU mesh"
