@@ -82,6 +82,12 @@ class EngineConfig:
     # Execute one batch per (B, T) bucket at startup so no compile lands in
     # the serving path. Off by default: tests construct many engines.
     warmup_compile: bool = False
+    # DFA tables are padded to a multiple of this many states before entering
+    # the jitted decode as arguments; one pad bucket = one compiled decode
+    # executable shared by every grammar that fits it (the warmup-compiled
+    # shape covers registry tries up to ~2k services on the byte vocab).
+    # Auto-shrunk for huge subword vocabs where dense padding costs HBM.
+    grammar_state_budget: int = 16384
     # Largest prompt bucket the startup warmup compiles for.
     warmup_max_len: int = 1024
 
@@ -134,6 +140,14 @@ class PlannerConfig:
     max_prompt_tokens: int = 1536
     plan_cache_size: int = 4096
     explain: bool = True
+    # Trie-constrain the grammar's service-name positions (VERDICT r1 #2):
+    #   "registry"  — one grammar over ALL registry names per registry
+    #                 version; every concurrent plan shares tables + decode
+    #                 executable (best batching; the default).
+    #   "shortlist" — per-(version, shortlist) grammar; tightest constraint
+    #                 but distinct shortlists split engine batches.
+    #   "off"       — shape-only grammar (names free-form; round-1 behavior).
+    constrain_names: str = "registry"
 
 
 @dataclass
@@ -209,6 +223,11 @@ class MCPXConfig:
             problems.append("registry.backend=redis requires registry.redis_url")
         if self.planner.kind not in ("llm", "heuristic", "mock"):
             problems.append(f"planner.kind '{self.planner.kind}' not in llm|heuristic|mock")
+        if self.planner.constrain_names not in ("registry", "shortlist", "off"):
+            problems.append(
+                f"planner.constrain_names '{self.planner.constrain_names}' "
+                "not in registry|shortlist|off"
+            )
         if self.engine.kv_page_size <= 0 or self.engine.kv_page_size & (self.engine.kv_page_size - 1):
             problems.append("engine.kv_page_size must be a positive power of two")
         if self.engine.data_axis < 1 or self.engine.model_axis < 1:

@@ -92,6 +92,11 @@ class Plan:
     edges: list[DagEdge] = field(default_factory=list)
     intent: str = ""
     explanation: str = ""
+    # Which planner actually produced this plan: "llm" | "heuristic" | "mock"
+    # | "" (unknown, e.g. /execute-supplied graphs). An LLM plan that fell
+    # back reads "heuristic" — this is what the bench's accept-rate and the
+    # ladder's llm_share report on (VERDICT r1 weak #1).
+    origin: str = ""
 
     # ------------------------------------------------------------------ build
     @classmethod
@@ -167,7 +172,8 @@ class Plan:
         if problems:
             raise PlanValidationError(problems)
         plan = cls(nodes=nodes, edges=edges, intent=str(obj.get("intent", "") or ""),
-                   explanation=str(obj.get("explanation", "") or ""))
+                   explanation=str(obj.get("explanation", "") or ""),
+                   origin=str(obj.get("origin", "") or ""))
         plan.validate()
         return plan
 
@@ -317,6 +323,7 @@ class Plan:
             ],
             **({"intent": self.intent} if self.intent else {}),
             **({"explanation": self.explanation} if self.explanation else {}),
+            **({"origin": self.origin} if self.origin else {}),
         }
 
     def to_json(self, **kw: Any) -> str:

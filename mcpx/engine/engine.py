@@ -58,6 +58,10 @@ class GenerateRequest:
     future: "asyncio.Future[GenerateResult]"
     loop: asyncio.AbstractEventLoop
     enqueued_at: float
+    # Grammar to constrain with (None = the engine's generic plan grammar).
+    # Requests sharing a grammar OBJECT can share a fused decode loop; the
+    # planner caches grammars per registry version so this is the common case.
+    grammar: Optional[PlanGrammar] = None
 
 
 @dataclasses.dataclass
@@ -135,13 +139,10 @@ class InferenceEngine:
                 | {ecfg.max_batch_size}
             )
         )
-        # DFA tables on device.
-        self._dfa_trans = jnp.asarray(self.grammar.transitions)
-        self._dfa_mask = jnp.asarray(self.grammar.mask)
-        # dist[s] = fewest samples (incl. EOS) to an accepted output from s;
-        # masking tokens whose successor can't finish in the remaining budget
-        # guarantees constrained decodes are never truncated mid-JSON.
-        self._dfa_dist = jnp.asarray(self.grammar.dist)
+        # DFA tables enter the jitted decode as ARGUMENTS (padded state dim,
+        # grammar.device_tables()), so per-registry grammars swap without
+        # recompiling; only the eos one-hot (vocab-shaped, grammar-free) is
+        # a closure constant.
         self._eos_onehot = jnp.zeros((self.grammar.mask.shape[1],), bool).at[
             self.tokenizer.eos_id
         ].set(True)
@@ -196,6 +197,7 @@ class InferenceEngine:
         max_new_tokens: int = 0,
         constrained: bool = True,
         temperature: Optional[float] = None,
+        grammar: Optional[PlanGrammar] = None,
     ) -> GenerateResult:
         if self.state != "ready":
             raise EngineError(f"engine not ready (state={self.state})")
@@ -208,6 +210,7 @@ class InferenceEngine:
             future=asyncio.get_running_loop().create_future(),
             loop=asyncio.get_running_loop(),
             enqueued_at=time.monotonic(),
+            grammar=grammar,
         )
         self._queue.put(req)
         return await req.future
@@ -299,8 +302,10 @@ class InferenceEngine:
             seq_lens = jnp.ones((B,), jnp.int32)
             table = jnp.zeros((B, ecfg.max_pages_per_seq), jnp.int32)
             spec_chunk = self._spec_chunk(True)
+            dfa = self.grammar.device_tables(self._grammar_pad())
             args = (
                 self._params,
+                *dfa,
                 last,
                 seq_lens,
                 budgets,
@@ -322,6 +327,20 @@ class InferenceEngine:
             self._paged_kv = {"k": k_p, "v": v_p}
         jax.block_until_ready(self._paged_kv["k"])
 
+    def _grammar_pad(self) -> int:
+        """State-dim pad quantum for grammar device tables. One pad bucket =
+        one decode executable, so warmup (generic grammar) and serving
+        (registry-trie grammar) share compiles as long as both fit the
+        budget. Dense tables are [S, vocab] int32 — for huge subword vocabs
+        a 16k-state pad would cost GBs of HBM, so the quantum shrinks to
+        minimal rounding there (registry tries are gated off for those
+        vocabs anyway; see planner.llm._MAX_TABLE_ENTRIES)."""
+        budget = self.config.engine.grammar_state_budget
+        V = self.grammar.mask.shape[1]
+        if budget * V > 64_000_000:  # > ~256MB of int32 transitions
+            return 64
+        return budget
+
     def _spec_chunk(self, constrained: bool) -> int:
         """Static speculation chunk width — config-derived only (it is a jit
         static arg: one executable shared by warmup and every batch). On
@@ -334,7 +353,7 @@ class InferenceEngine:
         return max(1, min(want, capacity - budget_ceiling))
 
     # --- jitted bodies ----------------------------------------------------
-    def _budget_mask(self, st, rem):
+    def _budget_mask(self, dfa, st, rem):
         """Allow token t iff grammar-legal AND (t is EOS or the successor
         state can still finish within the remaining sample budget) — this
         forces the JSON closed before the budget runs out. When the budget
@@ -342,21 +361,23 @@ class InferenceEngine:
         the shortest valid plan), degrade to the plain grammar mask: the
         output is then a legal prefix, never garbage. Shared by the plain
         and speculative decode impls — their emission semantics must stay
-        identical (tested byte-for-byte)."""
-        trans, mask_tab, dist = self._dfa_trans, self._dfa_mask, self._dfa_dist
+        identical (tested byte-for-byte). ``dfa`` = (trans, mask, dist)
+        device tables from ``PlanGrammar.device_tables()``."""
+        trans, mask_tab, dist = dfa
         legal = mask_tab[st]
         finishable = legal & (self._eos_onehot[None, :] | (dist[trans[st]] <= rem[:, None]))
         feasible = jnp.any(finishable, axis=-1, keepdims=True)
         return jnp.where(feasible, finishable, legal)
 
-    def _first_sample(self, first_logits, budgets, active, key, temperature, constrained):
+    def _first_sample(self, dfa, first_logits, budgets, active, key, temperature, constrained):
         """Sample the first emission from the prefill logits; returns
-        (cur0, state0, done0, key) with pad substituted for finished rows."""
+        (cur0, state0, done0, key) with pad substituted for finished rows.
+        State 0 is the grammar start (build_plan_grammar invariant)."""
         tok = self.tokenizer
         B = budgets.shape[0]
-        start_state = jnp.full((B,), self.grammar.start_state, jnp.int32)
+        start_state = jnp.zeros((B,), jnp.int32)
         key, sub = jax.random.split(key)
-        mask0 = self._budget_mask(start_state, budgets - 1) if constrained else None
+        mask0 = self._budget_mask(dfa, start_state, budgets - 1) if constrained else None
         first = sample(
             first_logits,
             sub,
@@ -366,7 +387,7 @@ class InferenceEngine:
         ).astype(jnp.int32)
         done0 = (first == tok.eos_id) | ~active | (budgets < 1)
         cur0 = jnp.where(done0, tok.pad_id, first)
-        state0 = self._dfa_trans[start_state, cur0]
+        state0 = dfa[0][start_state, cur0]
         return cur0, state0, done0, key
 
     def _prefill_impl(self, params, tokens, seq_lens, paged_k, paged_v, page_table, *, T):
@@ -387,6 +408,9 @@ class InferenceEngine:
     def _decode_impl(
         self,
         params,
+        dfa_trans,
+        dfa_mask,
+        dfa_dist,
         first_logits,
         seq_lens,
         budgets,
@@ -403,10 +427,11 @@ class InferenceEngine:
     ):
         cfg = self.model_cfg
         tok = self.tokenizer
-        trans = self._dfa_trans
+        dfa = (dfa_trans, dfa_mask, dfa_dist)
+        trans = dfa_trans
         budget_mask = self._budget_mask
         cur0, state0, done0, key = self._first_sample(
-            first_logits, budgets, active, key, temperature, constrained
+            dfa, first_logits, budgets, active, key, temperature, constrained
         )
 
         def cond(c):
@@ -429,7 +454,7 @@ class InferenceEngine:
             key, sub = jax.random.split(key)
             # This sample is emission i+2 (the pre-loop token was emission 1),
             # so budgets-(i+2) samples remain after it.
-            mask = budget_mask(st, budgets - (i + 2)) if constrained else None
+            mask = budget_mask(dfa, st, budgets - (i + 2)) if constrained else None
             nxt = sample(
                 logits, sub, temperature=temperature, top_k=self.config.engine.top_k, mask=mask
             ).astype(jnp.int32)
@@ -458,6 +483,9 @@ class InferenceEngine:
     def _decode_spec_impl(
         self,
         params,
+        dfa_trans,
+        dfa_mask,
+        dfa_dist,
         first_logits,
         seq_lens,
         budgets,
@@ -490,12 +518,13 @@ class InferenceEngine:
         cfg = self.model_cfg
         tok = self.tokenizer
         B = seq_lens.shape[0]
-        trans, mask_tab = self._dfa_trans, self._dfa_mask
+        dfa = (dfa_trans, dfa_mask, dfa_dist)
+        trans, mask_tab = dfa_trans, dfa_mask
         budget_mask = self._budget_mask
         pad, eos = tok.pad_id, tok.eos_id
         b_idx = jnp.arange(B)
         cur0, state0, done0, key = self._first_sample(
-            first_logits, budgets, active, key, temperature, True
+            dfa, first_logits, budgets, active, key, temperature, True
         )
         e0 = jnp.where(done0, 0, 1).astype(jnp.int32)
         buf0 = out_buf.at[b_idx, 0].set(cur0)
@@ -560,7 +589,7 @@ class InferenceEngine:
                 sub,
                 temperature=temperature,
                 top_k=self.config.engine.top_k,
-                mask=budget_mask(st1, budgets - e1 - 1),
+                mask=budget_mask(dfa, st1, budgets - e1 - 1),
             ).astype(jnp.int32)
             newly_done = done1 | (nxt == eos) | (e1 >= budgets)
             nxt = jnp.where(newly_done, pad, nxt)
@@ -630,8 +659,10 @@ class InferenceEngine:
             if not pending:
                 continue
             # Only requests with identical sampling semantics share a fused
-            # decode loop (constrained flag and temperature are batch-wide);
-            # the rest stay pending for the next round.
+            # decode loop (constrained flag, temperature and grammar are
+            # batch-wide); the rest stay pending for the next round. Grammar
+            # compatibility is OBJECT identity — the planner caches one
+            # grammar per registry version, so concurrent plans share it.
             head = pending[0]
             compat: list[GenerateRequest] = []
             rest: list[GenerateRequest] = []
@@ -640,6 +671,7 @@ class InferenceEngine:
                     len(compat) < self.config.engine.max_batch_size
                     and r.constrained == head.constrained
                     and r.temperature == head.temperature
+                    and (not r.constrained or r.grammar is head.grammar)
                 ):
                     compat.append(r)
                 else:
@@ -758,9 +790,12 @@ class InferenceEngine:
             out_buf = jnp.full((B, steps), tok.pad_id, jnp.int32)
             # Batch-wide by worker invariant (see _worker's compat split).
             temperature = batch[0].temperature
+            grammar = batch[0].grammar or self.grammar
+            dfa = grammar.device_tables(self._grammar_pad())
             if spec_chunk > 1:
                 buf, st, done, k_p, v_p, n_fwd = self._jit_decode_spec(
                     self._params,
+                    *dfa,
                     last_logits,
                     jnp.asarray(seq_lens),
                     jnp.asarray(budgets),
@@ -777,6 +812,7 @@ class InferenceEngine:
             else:
                 buf, st, done, k_p, v_p, n_fwd = self._jit_decode(
                     self._params,
+                    *dfa,
                     last_logits,
                     jnp.asarray(seq_lens),
                     jnp.asarray(budgets),

@@ -26,6 +26,10 @@ class PlanContext:
     shortlist: Optional[list[str]] = None
     # Services a replan must avoid (observed failing in this request).
     exclude: set[str] = field(default_factory=set)
+    # Registry version this context was built against (None = caller didn't
+    # snapshot one; consumers fetch it themselves). Keys the planner's
+    # per-registry grammar cache.
+    registry_version: Optional[int] = None
 
 
 @runtime_checkable

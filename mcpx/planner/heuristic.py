@@ -64,6 +64,7 @@ class HeuristicPlanner:
             selected = [scored[0][1]]
 
         plan = self._chain(intent, selected)
+        plan.origin = "heuristic"
         if self._cfg.explain:
             plan.explanation = self._explain(intent, selected, plan, context)
         plan.validate()

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+from collections import OrderedDict
 from typing import Optional
 
 from mcpx.core.config import MCPXConfig, PlannerConfig
@@ -29,10 +30,18 @@ from mcpx.core.dag import Plan, PlanValidationError
 from mcpx.core.errors import PlannerError
 from mcpx.engine.engine import InferenceEngine
 from mcpx.planner.base import PlanContext
+from mcpx.planner.grammar import PlanGrammar, build_plan_grammar
 from mcpx.planner.heuristic import HeuristicPlanner
-from mcpx.registry.base import ServiceRecord
+from mcpx.registry.base import ServiceRecord, stable_snapshot
 
 log = logging.getLogger("mcpx.planner.llm")
+
+# Dense token tables are [n_states, vocab] int32 — cap name-constrained
+# grammars at ~256MB of transition table. Byte vocab (384): ~166k states,
+# far above any realistic registry. Subword vocabs (SentencePiece 256k):
+# tries don't fit densely; those fall back to the shape-only grammar until
+# a sparse table representation exists.
+_MAX_TABLE_ENTRIES = 64_000_000
 
 
 class LLMPlanner:
@@ -47,9 +56,20 @@ class LLMPlanner:
         self.config = config or PlannerConfig()
         self.fallback = fallback or HeuristicPlanner(self.config)
         self._start_lock = asyncio.Lock()
+        # (registry_version, shortlist-or-None) → compiled PlanGrammar.
+        # Grammar identity is what lets concurrent requests share one fused
+        # decode batch (engine groups by grammar object), so cache hits
+        # matter for batching, not just build time.
+        self._grammar_cache: "OrderedDict[tuple, PlanGrammar]" = OrderedDict()
+        self._grammar_lock = asyncio.Lock()
 
     @classmethod
     def from_config(cls, config: MCPXConfig, retriever=None) -> "LLMPlanner":
+        # ``retriever`` intentionally unused: retrieval shortlists arrive via
+        # PlanContext.shortlist (built by ControlPlane._context), keeping the
+        # planner stateless w.r.t. the index. Accepted for signature parity
+        # with planners that do hold one.
+        del retriever
         return cls(InferenceEngine(config), config.planner)
 
     # -------------------------------------------------------------- lifecycle
@@ -67,16 +87,28 @@ class LLMPlanner:
     # ------------------------------------------------------------------ plan
     async def plan(self, intent: str, context: PlanContext) -> Plan:
         await self.ensure_ready()
-        services = await self._candidates(context)
+        # Version + contents read atomically: the grammar cache is keyed by
+        # version, so its names must come from exactly that version.
+        version, all_services = await stable_snapshot(context.registry)
+        services = self._candidates(all_services, context)
         if not services:
             raise PlannerError("registry is empty; nothing to plan with")
-        by_name = {s.name: s for s in services}
+        # Resolution map spans the WHOLE registry: with constrain_names=
+        # "registry" the grammar guarantees emitted names exist somewhere in
+        # the registry, not necessarily in the shortlist — any registry name
+        # resolves (excluded services stay out; a replan must avoid them).
+        by_name = {
+            s.name: s for s in all_services if s.name not in context.exclude
+        }
+        grammar = await self._grammar(context, version, all_services)
         prompt = self._prompt(intent, services, context)
         prompt_ids = self.engine.tokenizer.encode(prompt)
 
         last_problems: list[str] = []
         for attempt in range(self.config.max_plan_retries + 1):
-            res = await self.engine.generate(prompt_ids, constrained=True)
+            res = await self.engine.generate(
+                prompt_ids, constrained=True, grammar=grammar
+            )
             try:
                 plan = Plan.from_json(res.text)
             except PlanValidationError as e:
@@ -90,6 +122,7 @@ class LLMPlanner:
                 continue
             self._resolve(plan, by_name)
             plan.intent = intent
+            plan.origin = "llm"
             if self.config.explain:
                 plan.explanation = self._explain(plan, attempt)
             return plan
@@ -108,8 +141,10 @@ class LLMPlanner:
         return plan
 
     # -------------------------------------------------------------- internals
-    async def _candidates(self, context: PlanContext) -> list[ServiceRecord]:
-        services = await context.registry.list_services()
+    def _candidates(
+        self, all_services: list[ServiceRecord], context: PlanContext
+    ) -> list[ServiceRecord]:
+        services = all_services
         if context.exclude:
             services = [s for s in services if s.name not in context.exclude]
         if context.shortlist:
@@ -120,6 +155,57 @@ class LLMPlanner:
             if short:
                 return short
         return services
+
+    async def _grammar(
+        self, context: PlanContext, version: int, all_services: list[ServiceRecord]
+    ) -> Optional[PlanGrammar]:
+        """Grammar whose service-name positions are trie-constrained per
+        ``config.constrain_names``; None = the engine's shape-only default.
+        Cached per (registry version, shortlist) — the same object is
+        returned to every concurrent request so the engine can batch them
+        into one fused decode loop. ``version``/``all_services`` must be an
+        atomic observation (``stable_snapshot``)."""
+        mode = self.config.constrain_names
+        if mode == "off":
+            return None
+        if mode == "shortlist" and context.shortlist:
+            key = (version, tuple(context.shortlist))
+            names = list(key[1])
+        else:
+            key = (version, None)
+            names = [s.name for s in all_services]
+        if not names:
+            return None
+        cached = self._grammar_cache.get(key)
+        if cached is not None:
+            self._grammar_cache.move_to_end(key)
+            return cached
+        # Dense-table size gate (see _MAX_TABLE_ENTRIES).
+        est_states = 96 + 2 * sum(len(n) + 2 for n in names)
+        if est_states * self.engine.tokenizer.vocab_size > _MAX_TABLE_ENTRIES:
+            log.warning(
+                "name trie (%d names, ~%d states) too large for vocab %d; "
+                "using shape-only grammar",
+                len(names), est_states, self.engine.tokenizer.vocab_size,
+            )
+            return None
+        async with self._grammar_lock:
+            cached = self._grammar_cache.get(key)
+            if cached is not None:
+                return cached
+            try:
+                grammar = await asyncio.to_thread(
+                    build_plan_grammar, self.engine.tokenizer, names
+                )
+            except ValueError as e:
+                log.warning(
+                    "service names not trie-compilable (%s); using shape-only grammar", e
+                )
+                return None
+            self._grammar_cache[key] = grammar
+            while len(self._grammar_cache) > 16:
+                self._grammar_cache.popitem(last=False)
+            return grammar
 
     def _prompt(self, intent: str, services: list[ServiceRecord], context: PlanContext) -> str:
         """Compact prompt: shortlist + telemetry features + intent, trimmed to

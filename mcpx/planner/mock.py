@@ -43,4 +43,5 @@ class MockPlanner:
         plan = copy.deepcopy(plan)
         plan.validate()
         plan.intent = intent
+        plan.origin = plan.origin or "mock"
         return plan

@@ -95,3 +95,20 @@ class RegistryBackend(Protocol):
     async def list_services(self) -> list[ServiceRecord]: ...
 
     async def version(self) -> int: ...
+
+
+async def stable_snapshot(registry: RegistryBackend) -> "tuple[int, list[ServiceRecord]]":
+    """(version, services) observed ATOMICALLY: re-reads until the version is
+    unchanged across the list call, so callers keying caches by version (the
+    planner's grammar cache, the plan cache) never attach one version's
+    content to another's key under concurrent registry mutation."""
+    v = await registry.version()
+    for _ in range(8):
+        records = await registry.list_services()
+        v2 = await registry.version()
+        if v2 == v:
+            return v, records
+        v = v2
+    # Registry churning faster than we can read it: newest observation wins
+    # (a later request will re-snapshot).
+    return v2, records

@@ -136,6 +136,9 @@ def build_app(cp: ControlPlane) -> web.Application:
             {
                 "graph": p.to_wire(),
                 "explanation": p.explanation,
+                # Which planner authored the plan ("llm" | "heuristic" | ...):
+                # lets clients/benchmarks attribute accept rate per request.
+                "origin": p.origin,
                 "latency_ms": round(latency_ms, 3),
             }
         )

@@ -75,9 +75,15 @@ class ControlPlane:
         context = await self._context(intent, version=version)
         try:
             plan = await self.planner.plan(intent, context)
-            self.metrics.plans.labels(planner=type(self.planner).__name__, status="ok").inc()
+            self.metrics.plans.labels(
+                planner=type(self.planner).__name__,
+                origin=plan.origin or "unknown",
+                status="ok",
+            ).inc()
         except Exception:
-            self.metrics.plans.labels(planner=type(self.planner).__name__, status="error").inc()
+            self.metrics.plans.labels(
+                planner=type(self.planner).__name__, origin="none", status="error"
+            ).inc()
             raise
         if use_cache and self.config.planner.plan_cache_size > 0:
             self._cache_put(key, plan)
@@ -106,11 +112,14 @@ class ControlPlane:
             k = self.config.planner.shortlist_top_k
             names = await self.retriever.shortlist(intent, k + len(exclude))
             shortlist = [n for n in names if n not in exclude][:k]
+        if version is None:
+            version = await self.registry.version()
         return PlanContext(
             registry=self.registry,
             telemetry=self.telemetry.snapshot(),
             shortlist=shortlist,
             exclude=exclude,
+            registry_version=version,
         )
 
     # --------------------------------------------------------------- execute

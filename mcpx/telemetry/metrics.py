@@ -41,7 +41,12 @@ class Metrics:
             registry=self.registry,
         )
         self.plans = Counter(
-            "mcpx_plans_total", "Plans produced", ["planner", "status"], registry=self.registry
+            "mcpx_plans_total",
+            "Plans produced. origin: which planner actually authored the plan "
+            "('llm' vs 'heuristic' exposes the LLM accept rate — an LLMPlanner "
+            "whose every plan reads origin='heuristic' is 100%-falling-back)",
+            ["planner", "origin", "status"],
+            registry=self.registry,
         )
         self.service_calls = Counter(
             "mcpx_service_calls_total",

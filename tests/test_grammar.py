@@ -280,3 +280,111 @@ def test_byte_tokenizer_product_is_identity_lift():
     tok = ByteTokenizer()
     g = build_plan_grammar(tok)
     np.testing.assert_array_equal(g.transitions[:, :256], g.byte_transitions)
+
+
+# --- registry-constrained name tries (VERDICT r1 #2) -----------------------
+
+
+def test_trie_accepts_only_listed_names():
+    tok = ByteTokenizer()
+    names = ["auth-fetch", "auth-verify", "billing", "notify"]
+    g = build_plan_grammar(tok, names)
+    assert g.service_names == tuple(sorted(names))
+    ok = '{"steps":[{"s":"auth-fetch","in":["q"],"next":["notify"]}]}'
+    assert g.is_accept(g.walk(ok))
+    # unknown service name in "s" or "next" dies mid-string
+    assert g.walk('{"steps":[{"s":"auth-zzz","in":[],"next":[]}]}') == g.dead_state
+    assert g.walk('{"steps":[{"s":"billing","in":[],"next":["ghost"]}]}') == g.dead_state
+    # truncated legal prefix cannot close the string
+    assert g.walk('{"steps":[{"s":"auth","in":[],"next":[]}]}') == g.dead_state
+    # "in" keys stay free-form
+    assert g.is_accept(g.walk('{"steps":[{"s":"billing","in":["anything at all"],"next":[]}]}'))
+
+
+def test_trie_prefix_name_branches_on_quote():
+    g = build_plan_grammar(ByteTokenizer(), ["auth", "auth-fetch"])
+    assert g.is_accept(g.walk('{"steps":[{"s":"auth","in":[],"next":["auth-fetch"]}]}'))
+    assert g.is_accept(g.walk('{"steps":[{"s":"auth-fetch","in":[],"next":["auth"]}]}'))
+    assert g.walk('{"steps":[{"s":"auth-","in":[],"next":[]}]}') == g.dead_state
+
+
+def test_trie_random_legal_walk_names_only_registry_services():
+    """Any mask-legal walk through a trie grammar must terminate in plans
+    whose every service name is a listed one — the decode-time guarantee
+    the planner's accept path relies on."""
+    import json as _json
+
+    rng = np.random.default_rng(3)
+    tok = ByteTokenizer()
+    names = ["svc-alpha", "svc-beta", "other-gamma"]
+    g = build_plan_grammar(tok, names)
+    for trial in range(5):
+        state = g.start_state
+        ids = []
+        emitted = 0
+        while emitted < 300:
+            rem = 300 - emitted
+            allowed = [
+                int(t)
+                for t in np.flatnonzero(g.mask[state])
+                if t == tok.eos_id or int(g.dist[int(g.transitions[state, t])]) <= rem
+            ]
+            assert allowed, f"stranded at {state}"
+            t = int(rng.choice(allowed))
+            emitted += 1
+            if t == tok.eos_id:
+                break
+            ids.append(t)
+            state = int(g.transitions[state, t])
+        text = tok.decode(ids)
+        assert g.is_accept(g.walk(text)), text
+        obj = _json.loads(text)
+        for step in obj["steps"]:
+            assert step["s"] in names
+            assert all(nx in names for nx in step["next"])
+
+
+def test_trie_rejects_unencodable_names():
+    import pytest
+
+    with pytest.raises(ValueError):
+        build_plan_grammar(ByteTokenizer(), ['has"quote'])
+    with pytest.raises(ValueError):
+        build_plan_grammar(ByteTokenizer(), [""])
+
+
+def test_device_tables_pad_and_share():
+    tok = ByteTokenizer()
+    g = build_plan_grammar(tok, ["a-svc", "b-svc"])
+    trans, mask, dist = g.device_tables()
+    assert trans.shape[0] % 512 == 0 and trans.shape[0] >= g.n_states
+    assert trans.shape == mask.shape and dist.shape[0] == trans.shape[0]
+    # same objects on second call (one HBM copy per grammar)
+    t2, m2, d2 = g.device_tables()
+    assert t2 is trans and m2 is mask and d2 is dist
+    # padded rows: unreachable, all-False mask, PAD self-loop
+    n = g.n_states
+    assert not bool(np.asarray(mask)[n:].any())
+    assert int(np.asarray(trans)[n, tok.pad_id]) == n
+    # real rows match host tables
+    np.testing.assert_array_equal(np.asarray(trans)[:n], g.transitions)
+    np.testing.assert_array_equal(np.asarray(mask)[:n], g.mask)
+    np.testing.assert_array_equal(np.asarray(dist)[:n], g.dist)
+
+
+def test_engine_pad_makes_registry_grammar_share_warmup_shape():
+    """The engine's vocab-aware pad quantum must give the generic grammar and
+    a realistic registry trie identical padded table shapes — that equality
+    is what lets the warmup-compiled decode executable serve real requests
+    without an in-path XLA compile."""
+    from mcpx.engine.engine import InferenceEngine
+
+    eng = InferenceEngine()
+    pad = eng._grammar_pad()
+    generic = eng.grammar.device_tables(pad)
+    names = [f"svc-{kind}-{i:04d}" for kind in ("fetch", "rank", "notify") for i in range(50)]
+    trie = build_plan_grammar(ByteTokenizer(), names)
+    dev = trie.device_tables(pad)
+    assert generic[0].shape == dev[0].shape
+    assert generic[1].shape == dev[1].shape
+    assert generic[2].shape == dev[2].shape

@@ -150,10 +150,11 @@ def test_exclude_removes_candidates():
     asyncio.run(go())
 
 
-def test_model_in_the_loop_constrained_decode_falls_back_cleanly():
-    """Real engine, random weights: constrained decode yields grammar-valid
-    JSON whose service names are garbage -> planner must land on the
-    heuristic fallback without ever raising a parse error (bug B7 fixed)."""
+def test_model_in_the_loop_shape_only_grammar_falls_back_cleanly():
+    """Real engine, random weights, constrain_names=off (round-1 behavior):
+    constrained decode yields grammar-valid JSON whose service names are
+    garbage -> planner must land on the heuristic fallback without ever
+    raising a parse error (bug B7 fixed)."""
     from mcpx.engine.engine import InferenceEngine
 
     async def go():
@@ -167,7 +168,7 @@ def test_model_in_the_loop_constrained_decode_falls_back_cleanly():
                     "max_pages_per_seq": 16,
                     "temperature": 0.0,
                 },
-                "planner": {"kind": "llm", "max_plan_retries": 1},
+                "planner": {"kind": "llm", "max_plan_retries": 1, "constrain_names": "off"},
             }
         )
         eng = InferenceEngine(cfg)
@@ -179,5 +180,79 @@ def test_model_in_the_loop_constrained_decode_falls_back_cleanly():
             plan.validate()
         finally:
             await eng.aclose()
+
+    asyncio.run(go())
+
+
+@pytest.mark.parametrize("mode", ["registry", "shortlist"])
+def test_model_in_the_loop_trie_grammar_accepts_llm_plan(mode):
+    """Real engine, random weights, trie-constrained names (VERDICT r1 #2):
+    the model CANNOT emit an unknown service, so even noise-weight decodes
+    produce accepted LLM plans — origin stays 'llm', no heuristic fallback,
+    and every node resolves to a registry endpoint."""
+    from mcpx.engine.engine import InferenceEngine
+
+    async def go():
+        cfg = MCPXConfig.from_dict(
+            {
+                "model": {"size": "test", "max_seq_len": 256},
+                "engine": {
+                    "use_pallas": False,
+                    "max_batch_size": 2,
+                    "max_decode_len": 96,
+                    "max_pages_per_seq": 16,
+                    "temperature": 0.0,
+                },
+                "planner": {
+                    "kind": "llm",
+                    "max_plan_retries": 0,
+                    "constrain_names": mode,
+                },
+            }
+        )
+        eng = InferenceEngine(cfg)
+        p = LLMPlanner(eng, cfg.planner)
+        try:
+            reg = await _registry()
+            ctx = PlanContext(
+                registry=reg,
+                shortlist=["fetch", "summarize"] if mode == "shortlist" else None,
+            )
+            plan = await p.plan("fetch then summarize", ctx)
+            assert plan.origin == "llm", plan.explanation
+            assert plan.nodes
+            for n in plan.nodes:
+                assert n.service in ("fetch", "summarize")
+                assert n.endpoint.startswith("http://svc/")
+            plan.validate()
+        finally:
+            await eng.aclose()
+
+    asyncio.run(go())
+
+
+def test_grammar_cache_identity_per_registry_version():
+    """Concurrent plans against one registry version must share ONE grammar
+    object (engine batches by grammar identity); a registry mutation bumps
+    the version and yields a fresh grammar."""
+
+    async def go():
+        reg = await _registry()
+        eng = FakeEngine([GOOD] * 4)
+        p = LLMPlanner(eng, PlannerConfig(kind="llm"))
+        v = await reg.version()
+        ctx = PlanContext(registry=reg, registry_version=v)
+        recs = await reg.list_services()
+        g1, g2 = await asyncio.gather(p._grammar(ctx, v, recs), p._grammar(ctx, v, recs))
+        assert g1 is g2
+        assert g1 is not None and g1.service_names == ("fetch", "summarize")
+        await reg.put(ServiceRecord(name="extra", endpoint="http://svc/extra"))
+        v2 = await reg.version()
+        assert v2 != v
+        ctx2 = PlanContext(registry=reg, registry_version=v2)
+        recs2 = await reg.list_services()
+        g3 = await p._grammar(ctx2, v2, recs2)
+        assert g3 is not g1
+        assert g3.service_names is not None and "extra" in g3.service_names
 
     asyncio.run(go())

@@ -24,7 +24,7 @@ def test_error_rate_tracks_failures():
 
 def test_metrics_render_isolated_registries():
     m1, m2 = Metrics(), Metrics()
-    m1.plans.labels(planner="Mock", status="ok").inc()
+    m1.plans.labels(planner="Mock", origin="mock", status="ok").inc()
     text = m1.render().decode()
     assert "mcpx_plans_total" in text
     assert 'planner="Mock"' in text
