@@ -53,14 +53,35 @@ class ModelConfig:
 
 @dataclass
 class EngineConfig:
-    # Mesh axis sizes; data*model must divide len(jax.devices()) usage site.
-    data_axis: int = 1
-    model_axis: int = 1
+    # Mesh axis sizes. 0 = auto: cover every visible device (TP over the
+    # largest head-dividing factor, keeping a data axis >= 2 when possible —
+    # 2x4 on a v5e-8 with 8-head Gemma-2B). Explicit values are clamped to
+    # the device count.
+    data_axis: int = 0
+    model_axis: int = 0
     kv_page_size: int = 16  # tokens per KV page
     max_pages_per_seq: int = 128
     max_batch_size: int = 32
     max_prefill_tokens: int = 4096
-    decode_steps_per_tick: int = 8
+    # Model forwards per decode segment. Between segments the worker admits
+    # newly-arrived requests into free slab rows (continuous batching), so
+    # this bounds admission latency: smaller = lower p50 under load, larger
+    # = fewer host round-trips per token. With speculation each forward
+    # covers up to speculate_k tokens.
+    decode_steps_per_tick: int = 2
+    # Once the head of the pending line has waited this long behind an
+    # incompatible slab (different grammar/temperature), stop admitting new
+    # rows so the slab drains and the head can run.
+    fairness_timeout_s: float = 0.5
+    # Admission hysteresis: while the slab is busy, hold off prefilling a
+    # new cohort until at least this many rows are free (0 = auto:
+    # max_batch_size/4). Staggered retirements otherwise trigger a storm of
+    # small-cohort prefills, each costing as much wall time as several
+    # decode segments — prefill is compute-bound, decode is weight-bound.
+    admit_min_free: int = 0
+    # ...but never hold a pending request longer than this waiting for a
+    # fuller cohort (an idle slab always admits immediately).
+    admit_max_wait_s: float = 0.15
     max_decode_len: int = 512
     # Sampling defaults: temperature matches the reference planner call,
     # control_plane.py:72.
@@ -115,6 +136,11 @@ class TelemetryConfig:
     enabled: bool = True
     # EWMA smoothing for per-service latency/error-rate.
     ewma_alpha: float = 0.2
+    # Redis mirror (reference README.md:43-44 "Prometheus -> Redis"): when a
+    # URL is set, each replica exports its local stats snapshot and imports
+    # every peer's, so replicas plan with shared live telemetry.
+    redis_url: str = ""
+    mirror_interval_s: float = 2.0
     # Replan when a node's observed error-rate breaches this threshold.
     replan_error_rate: float = 0.5
     # or when latency exceeds this multiple of the registry's cost profile.
@@ -230,8 +256,8 @@ class MCPXConfig:
             )
         if self.engine.kv_page_size <= 0 or self.engine.kv_page_size & (self.engine.kv_page_size - 1):
             problems.append("engine.kv_page_size must be a positive power of two")
-        if self.engine.data_axis < 1 or self.engine.model_axis < 1:
-            problems.append("engine mesh axes must be >= 1")
+        if self.engine.data_axis < 0 or self.engine.model_axis < 0:
+            problems.append("engine mesh axes must be >= 0 (0 = auto)")
         if self.engine.max_batch_size < 1:
             problems.append("engine.max_batch_size must be >= 1")
         if not 0.0 < self.telemetry.ewma_alpha <= 1.0:

@@ -40,6 +40,14 @@ class GemmaConfig:
     def q_per_kv(self) -> int:
         return self.n_heads // self.n_kv_heads
 
+    @property
+    def n_params(self) -> int:
+        """Parameter count (tied embeddings counted once) — the basis for
+        model-FLOPs/token ≈ 2*n_params in MFU accounting."""
+        D, H, K, hd, F = self.d_model, self.n_heads, self.n_kv_heads, self.head_dim, self.d_ff
+        per_layer = D * H * hd + 2 * D * K * hd + H * hd * D + 3 * D * F + 2 * D
+        return self.vocab_size * D + self.n_layers * per_layer + D
+
     @classmethod
     def named(cls, name: str, *, vocab_size: int = 384, max_seq_len: int = 2048) -> "GemmaConfig":
         presets = {

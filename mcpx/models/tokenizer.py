@@ -32,6 +32,10 @@ class ByteTokenizer:
 
     def __init__(self) -> None:
         raw = 256 + _N_SPECIAL
+        # Real (denoting) ids; the rest is MXU padding — samplers must mask
+        # ids >= n_real on unconstrained paths (their logits are ordinary
+        # numbers, not "never chosen").
+        self.n_real = raw
         self.vocab_size = ((raw + _MXU_PAD - 1) // _MXU_PAD) * _MXU_PAD  # 384
 
     def encode(self, text: str, *, bos: bool = True, eos: bool = False) -> list[int]:
@@ -85,6 +89,7 @@ class SentencePieceTokenizer:
         pad = self._sp.pad_id()
         self.pad_id = pad if pad >= 0 else self._raw + 1
         raw_total = max(self._raw, self.bos_id + 1, self.pad_id + 1)
+        self.n_real = raw_total
         self.vocab_size = ((raw_total + _MXU_PAD - 1) // _MXU_PAD) * _MXU_PAD
 
     def encode(self, text: str, *, bos: bool = True, eos: bool = False) -> list[int]:
@@ -99,6 +104,24 @@ class SentencePieceTokenizer:
         return self._sp.decode([i for i in ids if 0 <= i < self._raw])
 
     def token_bytes(self) -> list[bytes | None]:
+        """Per-id byte surface as ``decode()`` will render it.
+
+        The grammar product requires: for any generated id sequence, the
+        concatenation of ``token_bytes`` equals the bytes of ``decode()``'s
+        output. Naively mapping ``id_to_piece(i).replace("▁", " ")`` breaks
+        that for pieces containing a literal U+2581 (ADVICE r2: corrupted
+        surfaces). Instead each piece is rendered through the *decoder
+        itself* behind a known single-byte anchor: ``decode([anchor, i]) ==
+        anchor_text + surface(i)`` byte-exactly — the anchor also defeats
+        the decoder's leading-whitespace strip so "▁foo" keeps its space.
+        Falls back to the replace heuristic only when the model has no byte
+        pieces to anchor with.
+        """
+        anchor_id, anchor_text = None, ""
+        for i in range(self._raw):
+            if self._sp.is_byte(i) and self._sp.id_to_piece(i) == "<0x41>":
+                anchor_id, anchor_text = i, "A"
+                break
         out: list[bytes | None] = []
         for i in range(self._raw):
             if self._sp.is_control(i) or self._sp.is_unknown(i):
@@ -106,6 +129,12 @@ class SentencePieceTokenizer:
             elif self._sp.is_byte(i):
                 piece = self._sp.id_to_piece(i)  # "<0xNN>"
                 out.append(bytes([int(piece[3:-1], 16)]))
+            elif anchor_id is not None:
+                s = self._sp.decode([anchor_id, i])
+                if s.startswith(anchor_text):
+                    out.append(s[len(anchor_text):].encode("utf-8"))
+                else:  # unexpected decoder behavior; heuristic fallback
+                    out.append(self._sp.id_to_piece(i).replace("▁", " ").encode("utf-8"))
             else:
                 out.append(self._sp.id_to_piece(i).replace("▁", " ").encode("utf-8"))
         out += [None] * (self.vocab_size - self._raw)

@@ -356,27 +356,37 @@ def test_trie_rejects_unencodable_names():
 def test_device_tables_pad_and_share():
     tok = ByteTokenizer()
     g = build_plan_grammar(tok, ["a-svc", "b-svc"])
-    trans, mask, dist = g.device_tables()
-    assert trans.shape[0] % 512 == 0 and trans.shape[0] >= g.n_states
-    assert trans.shape == mask.shape and dist.shape[0] == trans.shape[0]
+    trans, mask, dist, active_ids, eos_cols = g.device_tables()
+    n, c = g.ctrans.shape
+    assert trans.shape[0] % 512 == 0 and trans.shape[0] >= n
+    assert trans.shape[1] >= c and trans.shape == mask.shape
+    assert dist.shape[0] == trans.shape[0]
+    assert active_ids.shape == eos_cols.shape == (trans.shape[1],)
     # same objects on second call (one HBM copy per grammar)
-    t2, m2, d2 = g.device_tables()
-    assert t2 is trans and m2 is mask and d2 is dist
-    # padded rows: unreachable, all-False mask, PAD self-loop
-    n = g.n_states
+    t2 = g.device_tables()
+    assert t2[0] is trans and t2[1] is mask and t2[2] is dist
+    # padded rows/cols: unreachable, all-False mask, dead transitions
     assert not bool(np.asarray(mask)[n:].any())
-    assert int(np.asarray(trans)[n, tok.pad_id]) == n
-    # real rows match host tables
-    np.testing.assert_array_equal(np.asarray(trans)[:n], g.transitions)
-    np.testing.assert_array_equal(np.asarray(mask)[:n], g.mask)
+    assert not bool(np.asarray(mask)[:, c:].any())
+    assert np.all(np.asarray(trans)[n:] == g.cdead)
+    # real rows match compact host tables, which match the dense tables'
+    # active columns (dense path keeps both forms coherent)
+    np.testing.assert_array_equal(np.asarray(trans)[:n, :c], g.ctrans)
+    np.testing.assert_array_equal(np.asarray(mask)[:n, :c], g.cmask)
     np.testing.assert_array_equal(np.asarray(dist)[:n], g.dist)
+    np.testing.assert_array_equal(g.ctrans, g.transitions[:, g.active_ids])
+    np.testing.assert_array_equal(g.cmask, g.mask[:, g.active_ids])
+    # EOS is an active column; PAD never is
+    assert tok.eos_id in g.active_ids
+    assert tok.pad_id not in g.active_ids
+    assert bool(g.eos_cols[np.flatnonzero(g.active_ids == tok.eos_id)[0]])
 
 
 def test_engine_pad_makes_registry_grammar_share_warmup_shape():
-    """The engine's vocab-aware pad quantum must give the generic grammar and
-    a realistic registry trie identical padded table shapes — that equality
-    is what lets the warmup-compiled decode executable serve real requests
-    without an in-path XLA compile."""
+    """The engine's pad quanta must give the generic grammar and a realistic
+    registry trie identical padded table shapes — that equality is what lets
+    the warmup-compiled decode executable serve real requests without an
+    in-path XLA compile."""
     from mcpx.engine.engine import InferenceEngine
 
     eng = InferenceEngine()
@@ -385,6 +395,108 @@ def test_engine_pad_makes_registry_grammar_share_warmup_shape():
     names = [f"svc-{kind}-{i:04d}" for kind in ("fetch", "rank", "notify") for i in range(50)]
     trie = build_plan_grammar(ByteTokenizer(), names)
     dev = trie.device_tables(pad)
-    assert generic[0].shape == dev[0].shape
-    assert generic[1].shape == dev[1].shape
-    assert generic[2].shape == dev[2].shape
+    for a, b in zip(generic, dev):
+        assert a.shape == b.shape
+
+
+def _subword_tok(pieces: list[str], vocab_pad: int = 0):
+    """Minimal multi-byte-token tokenizer for exercising the grammar product
+    on subword vocabs without external files: bytes 0..255 are always
+    present (byte fallback), then the given pieces, then PAD/BOS/EOS."""
+
+    class SubwordTok:
+        def __init__(self) -> None:
+            self.pieces = [bytes([i]) for i in range(256)] + [
+                p.encode("utf-8") for p in pieces
+            ]
+            self.pad_id = len(self.pieces)
+            self.bos_id = self.pad_id + 1
+            self.eos_id = self.pad_id + 2
+            self.vocab_size = self.pad_id + 3 + vocab_pad
+
+        def token_bytes(self):
+            out = list(self.pieces)
+            out += [None] * (self.vocab_size - len(out))
+            return out
+
+        def decode(self, ids):
+            data = b"".join(
+                self.pieces[i] for i in ids if 0 <= i < len(self.pieces)
+            )
+            return data.decode("utf-8", errors="replace")
+
+    return SubwordTok()
+
+
+def test_sparse_product_matches_dense():
+    """The sparse BFS product (huge-vocab path) must accept exactly the same
+    strings as the dense product: equal min_len, equal legal-token sets
+    along a greedy walk, and a full emitted plan that byte-walks to accept."""
+    import mcpx.planner.grammar as G
+
+    names = ["alpha-svc", "alpine-svc", "beta"]
+    keys = ["user_id", "query"]
+    pieces = ['{"steps":[{"s":"', 'alpha', '-svc', '","in":[', '"user_id"',
+              '],"next":[', ']}', ']}'[0], 'alp', 'beta', '"query"', '",']
+    tok = _subword_tok(pieces)
+    dense = G.build_plan_grammar(tok, names, input_keys=keys)
+    assert dense.transitions is not None  # small vocab -> dense path
+
+    # Force the sparse path by shrinking the dense-entries budget.
+    old = G._DENSE_ENTRIES_MAX
+    G._DENSE_ENTRIES_MAX = 1
+    try:
+        sparse = G.build_plan_grammar(tok, names, input_keys=keys)
+    finally:
+        G._DENSE_ENTRIES_MAX = old
+    assert sparse.transitions is None  # sparse path taken
+
+    assert sparse.min_len == dense.min_len
+    # Same active token set.
+    np.testing.assert_array_equal(sparse.active_ids, dense.active_ids)
+
+    # Greedy forced-completion walk through BOTH automata emits identical
+    # token sequences and lands in accept.
+    def emit(g):
+        st, out = g.start_state, []
+        for _ in range(200):
+            legal = np.flatnonzero(g.cmask[st])
+            assert legal.size, (st, out)
+            # prefer EOS when legal, else smallest finishing column
+            eos_legal = [c for c in legal if g.eos_cols[c]]
+            if eos_legal:
+                return out, True
+            c = min(legal, key=lambda c: int(g.dist[int(g.ctrans[st, c])]))
+            out.append(int(g.active_ids[c]))
+            st = int(g.ctrans[st, c])
+        return out, False
+
+    toks_d, done_d = emit(dense)
+    toks_s, done_s = emit(sparse)
+    assert done_d and done_s
+    assert toks_d == toks_s
+    text = tok.decode(toks_d)
+    assert dense.is_accept(dense.walk(text)), text
+    assert sparse.is_accept(sparse.walk(text)), text
+
+
+def test_sparse_free_strings_exceed_budget():
+    """Free-string positions on a large vocab must raise (the planner then
+    falls back through key tries to the shape-only grammar) rather than
+    building an enormous table."""
+    import mcpx.planner.grammar as G
+
+    tok = _subword_tok([f"piece{i}" for i in range(50)])
+    old_dense, old_budget = G._DENSE_ENTRIES_MAX, G._SPARSE_VISIT_BUDGET
+    G._DENSE_ENTRIES_MAX = 1
+    G._SPARSE_VISIT_BUDGET = 300
+    try:
+        import pytest
+
+        with pytest.raises(ValueError, match="budget"):
+            # names trie'd but "in" keys free -> permissive states blow the
+            # visit budget at this (artificially tiny) setting
+            G.build_plan_grammar(tok, ["alpha-svc"])
+    finally:
+        G._DENSE_ENTRIES_MAX = old_dense
+        G._SPARSE_VISIT_BUDGET = old_budget

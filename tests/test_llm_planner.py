@@ -126,11 +126,11 @@ def test_prompt_contains_telemetry_and_respects_shortlist_and_budget():
         prompt = eng.prompts[0]
         assert len(prompt) <= 600
         assert "err=0.25" in prompt
-        assert "p50=12ms" in prompt or "p50=13ms" in prompt
-        assert "cost=2" in prompt
+        assert "p50=12" in prompt or "p50=13" in prompt
+        assert "c=2" in prompt
         # Shortlisted services only, in retrieval order.
-        assert "summarize" in prompt and "- f3" not in prompt
-        assert prompt.index("- summarize ") < prompt.index("- fetch ")
+        assert "\nsummarize in:" in prompt and "\nf3 in:" not in prompt
+        assert prompt.index("\nsummarize in:") < prompt.index("\nfetch in:")
         assert prompt.rstrip().endswith("JSON:")
         assert "fetch and summarize" in prompt
 
