@@ -274,6 +274,19 @@ def build_app(cp: ControlPlane) -> web.Application:
 
     startup_task: dict[str, asyncio.Task] = {}
 
+    async def _mirror_loop() -> None:
+        # Telemetry Redis mirror (reference README.md:43-44 made real):
+        # periodic export of local stats + import of peer replicas'.
+        interval = cp.config.telemetry.mirror_interval_s
+        while True:
+            try:
+                await cp.telemetry_mirror.sync()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 - mirror loss must not kill serving
+                log.exception("telemetry mirror sync failed; retrying next interval")
+            await asyncio.sleep(interval)
+
     async def on_startup(app: web.Application) -> None:
         # Engine bring-up (weight load + bucket compile warmup) runs as a
         # background task, not inline: on_startup fires before the listening
@@ -283,10 +296,23 @@ def build_app(cp: ControlPlane) -> web.Application:
         # wait inside engine.start(), which coalesces concurrent callers
         # (SURVEY.md §3.4: startup is a first-class, observable phase).
         startup_task["t"] = asyncio.create_task(cp.startup())
+        if cp.telemetry_mirror is not None:
+            startup_task["mirror"] = asyncio.create_task(_mirror_loop())
 
     app.on_startup.append(on_startup)
 
     async def on_cleanup(app: web.Application) -> None:
+        m = startup_task.pop("mirror", None)
+        if m is not None:
+            m.cancel()
+            try:
+                await m
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+            try:
+                await cp.telemetry_mirror.aclose()
+            except Exception:  # noqa: BLE001 - best-effort at shutdown
+                log.exception("telemetry mirror close failed")
         t = startup_task.pop("t", None)
         if t is not None:
             if not t.done():

@@ -37,6 +37,7 @@ class ControlPlane:
         metrics: Optional[Metrics] = None,
         retriever: Any = None,  # mcpx.retrieval.Index (duck-typed: async shortlist(intent, k))
         replan_policy: Optional[ReplanPolicy] = None,
+        telemetry_mirror: Any = None,  # mcpx.telemetry.mirror.RedisTelemetryMirror
     ) -> None:
         self.config = config or MCPXConfig()
         self.registry = registry
@@ -46,6 +47,7 @@ class ControlPlane:
         self.metrics = metrics or Metrics()
         self.retriever = retriever
         self.replan_policy = replan_policy or ReplanPolicy(self.config.telemetry)
+        self.telemetry_mirror = telemetry_mirror
         self._plan_cache: OrderedDict[tuple[str, int], Plan] = OrderedDict()
 
     # ------------------------------------------------------------- lifecycle
@@ -160,5 +162,8 @@ class ControlPlane:
             "errors": result.errors,
             "status": result.status,
             "replans": trace.replans,
+            # Which planner authored the final plan — lets benchmarks gate on
+            # the LLM accept rate end-to-end (VERDICT r2 #9).
+            "origin": plan.origin,
             "trace": result.trace.to_dict() if result.trace else None,
         }

@@ -55,6 +55,11 @@ def build_control_plane(
                         e,
                     )
     telemetry = TelemetryStore(config.telemetry.ewma_alpha)
+    telemetry_mirror = None
+    if config.telemetry.enabled and config.telemetry.redis_url:
+        from mcpx.telemetry.mirror import RedisTelemetryMirror
+
+        telemetry_mirror = RedisTelemetryMirror(telemetry, config.telemetry.redis_url)
     metrics = Metrics()
     orchestrator = Orchestrator(
         transport,
@@ -75,7 +80,7 @@ def build_control_plane(
                 from mcpx.core.errors import ConfigError
 
                 raise ConfigError(f"planner.kind=llm unavailable: {e}") from e
-            planner = LLMPlanner.from_config(config, retriever=retriever)
+            planner = LLMPlanner.from_config(config, retriever=retriever, metrics=metrics)
     return ControlPlane(
         config=config,
         registry=registry,
@@ -85,4 +90,5 @@ def build_control_plane(
         metrics=metrics,
         retriever=retriever,
         replan_policy=ReplanPolicy(config.telemetry),
+        telemetry_mirror=telemetry_mirror,
     )

@@ -8,8 +8,11 @@ candidate services by live cost/latency/error-rate; the replan policy
 (``mcpx.telemetry.replan``) reads it to decide when observed behaviour has
 drifted from the plan's assumptions.
 
-Pure in-process and lock-free under asyncio (single event loop writer); a
-Redis-mirroring exporter can be layered on top without changing callers.
+Pure in-process and lock-free under asyncio (single event loop writer). The
+Redis mirror (``mcpx.telemetry.mirror``) layers cross-replica sharing on
+top: peer replicas' snapshots are held SEPARATELY from local observations
+and blended call-weighted at read time, so re-importing a peer snapshot is
+idempotent (never double-counted into local EWMAs).
 """
 
 from __future__ import annotations
@@ -46,6 +49,8 @@ class TelemetryStore:
             raise ValueError("alpha must be in (0, 1]")
         self._alpha = alpha
         self._stats: dict[str, ServiceStats] = {}
+        # replica id -> {service -> ServiceStats} imported by the mirror.
+        self._peers: dict[str, dict[str, ServiceStats]] = {}
 
     def record(
         self,
@@ -74,10 +79,61 @@ class TelemetryStore:
         s.last_update = time.monotonic()
 
     def get(self, service: str) -> Optional[ServiceStats]:
-        return self._stats.get(service)
+        """Blended view: local observations + peer replicas' snapshots,
+        weighted by call counts (a peer that has called a service 100x
+        dominates our 2 local calls)."""
+        entries = []
+        local = self._stats.get(service)
+        if local is not None:
+            entries.append(local)
+        for peer in self._peers.values():
+            s = peer.get(service)
+            if s is not None:
+                entries.append(s)
+        return _blend(service, entries)
 
     def snapshot(self) -> dict[str, ServiceStats]:
+        names = set(self._stats)
+        for peer in self._peers.values():
+            names.update(peer)
+        out: dict[str, ServiceStats] = {}
+        for name in names:
+            s = self.get(name)
+            if s is not None:
+                out[name] = s
+        return out
+
+    def local_snapshot(self) -> dict[str, ServiceStats]:
+        """This replica's own observations only — what the mirror exports
+        (each replica exports local, so nothing is double-counted)."""
         return dict(self._stats)
+
+    def set_peer(self, replica_id: str, stats: dict[str, ServiceStats]) -> None:
+        self._peers[replica_id] = stats
+
+    def prune_peers(self, keep) -> None:
+        for rid in list(self._peers):
+            if rid not in keep:
+                del self._peers[rid]
 
     def reset(self) -> None:
         self._stats.clear()
+        self._peers.clear()
+
+
+def _blend(service: str, entries: list[ServiceStats]) -> Optional[ServiceStats]:
+    if not entries:
+        return None
+    if len(entries) == 1:
+        return entries[0]
+    total = sum(max(1, e.calls) for e in entries)
+    w = [max(1, e.calls) / total for e in entries]
+    return ServiceStats(
+        service=service,
+        ewma_latency_ms=sum(wi * e.ewma_latency_ms for wi, e in zip(w, entries)),
+        ewma_error_rate=sum(wi * e.ewma_error_rate for wi, e in zip(w, entries)),
+        ewma_cost=sum(wi * e.ewma_cost for wi, e in zip(w, entries)),
+        calls=sum(e.calls for e in entries),
+        errors=sum(e.errors for e in entries),
+        last_update=max(e.last_update for e in entries),
+    )
