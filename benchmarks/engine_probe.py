@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Direct-engine probe: drive InferenceEngine with concurrent constrained
+requests (no HTTP server, no retrieval) and print occupancy/cohort stats —
+the tool for attributing serving throughput between the engine proper and
+the control-plane layers above it."""
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+async def main():
+    from mcpx.core.config import MCPXConfig
+    from mcpx.engine.engine import InferenceEngine
+    from mcpx.planner.grammar import build_plan_grammar
+
+    n_req = int(os.environ.get("PROBE_REQUESTS", "256"))
+    cfg = MCPXConfig.from_dict(
+        {
+            "model": {"size": os.environ.get("PROBE_MODEL", "2b"), "max_seq_len": 2048},
+            "engine": {
+                "max_batch_size": int(os.environ.get("PROBE_BATCH", "64")),
+                "max_decode_len": 96,
+                "kv_page_size": 64,
+                "max_pages_per_seq": 16,
+                "temperature": 0.0,
+                "use_pallas": True,
+                "warmup_compile": True,
+                "decode_steps_per_tick": int(os.environ.get("PROBE_TICK", "2")),
+                "speculate_k": int(os.environ.get("PROBE_SPEC", "8")),
+            },
+        }
+    )
+    import jax
+    if jax.default_backend() == "cpu":
+        cfg.engine.use_pallas = False
+    eng = InferenceEngine(cfg)
+    t0 = time.monotonic()
+    await eng.start()
+    t_start = time.monotonic() - t0
+
+    names = [f"svc-{kind}-{i:04d}" for kind in ("fetch", "rank", "notify", "merge") for i in range(250)]
+    grammar = build_plan_grammar(eng.tokenizer, names)
+    prompt = ("Compose a service DAG. JSON\nServices:\n"
+              + "\n".join(f"{n} in:a,b out:c" for n in names[:6])
+              + "\nIntent: fetch and rank the things\nJSON:")
+    ids = eng.tokenizer.encode(prompt)
+
+    # warm one round
+    await asyncio.gather(*(eng.generate(ids, max_new_tokens=96, grammar=grammar)
+                           for _ in range(cfg.engine.max_batch_size)))
+    m0 = {k: c._value.get() for k, c in
+          [("fwd", eng.metrics.decode_forwards), ("tok", eng.metrics.decode_tokens),
+           ("adm", eng.metrics.admissions), ("rows", eng.metrics.admitted_rows),
+           ("segrows", eng.metrics.segment_active_rows), ("seg", eng.metrics.segments),
+           ("pft", eng.metrics.prefill_tokens)]}
+    t1 = time.monotonic()
+    results = await asyncio.gather(*(eng.generate(ids, max_new_tokens=96, grammar=grammar)
+                                     for _ in range(n_req)))
+    dt = time.monotonic() - t1
+    m1 = {k: c._value.get() for k, c in
+          [("fwd", eng.metrics.decode_forwards), ("tok", eng.metrics.decode_tokens),
+           ("adm", eng.metrics.admissions), ("rows", eng.metrics.admitted_rows),
+           ("segrows", eng.metrics.segment_active_rows), ("seg", eng.metrics.segments),
+           ("pft", eng.metrics.prefill_tokens)]}
+    d = {k: m1[k] - m0[k] for k in m0}
+    gen = sum(r.generated_tokens for r in results)
+    print(json.dumps({
+        "plans_per_sec": round(n_req / dt, 2),
+        "elapsed_s": round(dt, 2),
+        "startup_s": round(t_start, 1),
+        "gen_tokens": gen,
+        "decode_forwards": int(d["fwd"]),
+        "tok_per_forward": round(d["tok"] / max(1, d["fwd"]), 1),
+        "avg_cohort": round(d["rows"] / max(1, d["adm"]), 1),
+        "admissions": int(d["adm"]),
+        "avg_occupancy": round(d["segrows"] / max(1, d["seg"]), 1),
+        "segments": int(d["seg"]),
+        "prefill_tokens": int(d["pft"]),
+        "prompt_len": len(ids),
+        "p50_decode_ms": round(sorted(r.decode_ms for r in results)[n_req // 2], 1),
+        "p50_prefill_ms": round(sorted(r.prefill_ms for r in results)[n_req // 2], 1),
+        "p50_queue_ms": round(sorted(r.queue_ms for r in results)[n_req // 2], 1),
+    }))
+    await eng.aclose()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

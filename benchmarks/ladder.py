@@ -136,6 +136,10 @@ class _Stack:
         async with self.session.post(f"{self.base}/plan", json={"intent": intent}) as r:
             return {"status": r.status, **(await r.json())}
 
+    def counter(self, name: str) -> float:
+        c = getattr(self.cp.metrics, name)
+        return c._value.get()
+
     async def plan_and_execute(self, intent: str, payload: dict) -> dict:
         async with self.session.post(
             f"{self.base}/plan_and_execute", json={"intent": intent, "payload": payload}
@@ -156,15 +160,16 @@ async def config1(model: str) -> None:
     """Single-intent /plan over a 3-service registry: p50 latency."""
     async with _Stack(3, model) as st:
         lat = []
-        nodes = 0
+        nodes = llm = 0
         for i in range(24):
             t0 = time.monotonic()
             res = await st.plan(f"fetch auth data then enrich the user record [{i}]")
             lat.append((time.monotonic() - t0) * 1e3)
             assert res["status"] == 200, res
             nodes = max(nodes, len(res["graph"]["nodes"]))
+            llm += res.get("origin") == "llm"
         _emit(1, "single /plan p50 (3 services)", statistics.median(lat), "ms",
-              max_plan_nodes=nodes)
+              max_plan_nodes=nodes, llm_share=llm / 24)
 
 
 async def config2(model: str) -> None:
@@ -177,7 +182,7 @@ async def config2(model: str) -> None:
     flaky = records[0].name
     downed = next((r.name for r in records if r.fallbacks), records[1].name)
     async with _Stack(10, model, fail={flaky: "once", downed: "always"}) as st:
-        ok = retries = fallbacks = 0
+        ok = retries = fallbacks = llm = 0
         lat = []
         payload = {k: "x" for k in
                    ("query", "user_id", "order_id", "document", "text", "items", "amount",
@@ -188,13 +193,15 @@ async def config2(model: str) -> None:
                                             payload)
             lat.append((time.monotonic() - t0) * 1e3)
             ok += res.get("status") in ("ok", "partial")
+            llm += res.get("origin") == "llm"
             for node in (res.get("trace") or {}).get("nodes", []):
                 kinds = [a["kind"] for a in node.get("attempts", [])]
                 retries += "retry" in kinds
                 fallbacks += "fallback" in kinds
         _emit(2, "plan_and_execute p50 w/ retry+fallback (10 services)",
-              statistics.median(lat), "ms", ok=ok, total=12,
-              retries_exercised=retries, fallbacks_exercised=fallbacks)
+              statistics.median(lat), "ms", ok=ok, total=12, ok_rate=ok / 12,
+              llm_share=llm / 12, retries_exercised=retries,
+              fallbacks_exercised=fallbacks)
 
 
 async def config3(model: str) -> None:
@@ -206,12 +213,25 @@ async def config3(model: str) -> None:
     async with _Stack(100, model) as st:
         rng = random.Random(3)
         intents = [f"{intent_for(st.records, rng)} [{i}]" for i in range(96)]
+        fwd0, tok0 = st.counter("decode_forwards"), st.counter("decode_tokens")
         t0 = time.monotonic()
         results = await asyncio.gather(*(st.plan(i) for i in intents))
         dt = time.monotonic() - t0
         assert all(r["status"] == 200 for r in results)
+        llm = sum(r.get("origin") == "llm" for r in results)
+        fwd = st.counter("decode_forwards") - fwd0
+        tok = st.counter("decode_tokens") - tok0
+        # Batching proof: with a shared slab + speculation, model forwards
+        # must be far fewer than requests (96 serial unbatched plans would
+        # need >= 96 * min-plan-length forwards). A regression to serial
+        # decoding fails here rather than shipping a slow-but-green number.
+        assert fwd < len(intents) * 4, (
+            f"batching regressed: {fwd} forwards for {len(intents)} plans")
         _emit(3, "batched /plan throughput, top-k retrieval (100 services)",
-              len(intents) / dt, "plans/s", batch=32)
+              len(intents) / dt, "plans/s", concurrency=96,
+              engine_batch=st.cp.config.engine.max_batch_size,
+              llm_share=llm / len(intents), decode_forwards=int(fwd),
+              tok_per_forward=round(tok / max(1.0, fwd), 2))
 
 
 async def config4(model: str) -> None:
@@ -253,8 +273,10 @@ async def config5(model: str) -> None:
         )
         dt = time.monotonic() - t0
         ok = sum(r.get("status") in ("ok", "partial") for r in results)
+        llm = sum(r.get("origin") == "llm" for r in results)
         _emit(5, "256-concurrent plan_and_execute (1k services)",
-              len(intents) / dt, "req/s", ok=ok, total=len(intents))
+              len(intents) / dt, "req/s", ok=ok, total=len(intents),
+              ok_rate=ok / len(intents), llm_share=llm / len(intents))
 
 
 CONFIGS = [config1, config2, config3, config4, config5]
