@@ -111,6 +111,11 @@ class EngineConfig:
     grammar_state_budget: int = 16384
     # Largest prompt bucket the startup warmup compiles for.
     warmup_max_len: int = 1024
+    # Persistent XLA compilation cache directory ("" disables). Engine
+    # startup compiles dozens of (batch, length) bucket executables; the
+    # cache makes every startup after the first near-instant for unchanged
+    # shapes (minutes -> seconds on a real chip).
+    compilation_cache_dir: str = "~/.cache/mcpx-xla"
 
 
 @dataclass
@@ -174,6 +179,15 @@ class PlannerConfig:
     #                 but distinct shortlists split engine batches.
     #   "off"       — shape-only grammar (names free-form; round-1 behavior).
     constrain_names: str = "registry"
+    # Trie-constrain the "in" key positions to the union of the registry's
+    # input/output schema keys ("registry") or leave them free strings
+    # ("off"). Constrained is the default: plans should only reference keys
+    # some service actually produces or consumes, it is what keeps the
+    # grammar compact on big subword vocabs, and key tries make most key
+    # characters FORCED — roughly doubling grammar fast-forward speculation
+    # (free-string keys sample every character). Set "off" if callers pass
+    # payload keys outside any schema.
+    constrain_input_keys: str = "registry"
 
 
 @dataclass
@@ -253,6 +267,11 @@ class MCPXConfig:
             problems.append(
                 f"planner.constrain_names '{self.planner.constrain_names}' "
                 "not in registry|shortlist|off"
+            )
+        if self.planner.constrain_input_keys not in ("registry", "off"):
+            problems.append(
+                f"planner.constrain_input_keys '{self.planner.constrain_input_keys}' "
+                "not in registry|off"
             )
         if self.engine.kv_page_size <= 0 or self.engine.kv_page_size & (self.engine.kv_page_size - 1):
             problems.append("engine.kv_page_size must be a positive power of two")

@@ -357,9 +357,21 @@ class InferenceEngine:
         return P(_axis(self._mesh, DATA_AXIS, n), *([None] * extra_dims))
 
     def _setup(self) -> None:
-        from mcpx.parallel.mesh import MODEL_AXIS, _axis, make_mesh
+        import os
+
+        from mcpx.parallel.mesh import make_mesh
 
         ecfg = self.config.engine
+        if ecfg.compilation_cache_dir:
+            # Best-effort persistent XLA cache: startup compiles dozens of
+            # bucket executables; caching makes warm restarts near-instant.
+            try:
+                path = os.path.expanduser(ecfg.compilation_cache_dir)
+                os.makedirs(path, exist_ok=True)
+                jax.config.update("jax_compilation_cache_dir", path)
+                jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+            except Exception as e:  # noqa: BLE001 - cache is an optimisation
+                log.warning("persistent compilation cache unavailable: %s", e)
         # Mosaic tiles the last (lane) dim at 128: head dims that don't align
         # can't use the Pallas kernel on hardware — fall back to the fused-jnp
         # paged attention (interpret mode has no such constraint).

@@ -81,6 +81,28 @@ class LLMPlanner:
         if self.engine.state != "ready":
             raise PlannerError(f"inference engine unavailable (state={self.engine.state})")
 
+    async def warm(self, registry) -> None:
+        """Compile the serving path for the CURRENT registry grammar: build
+        the trie grammar for the latest snapshot and push one minimal
+        generate through it, so the admit/segment executables for its pad
+        bucket exist before the first real request (the engine's own warmup
+        covers only the generic grammar — on big subword vocabs a registry
+        trie lands in a different column bucket). Called by
+        ControlPlane.startup; failures are non-fatal (first request then
+        pays the compile instead)."""
+        await self.ensure_ready()
+        version, all_services = await stable_snapshot(registry)
+        if not all_services:
+            return
+        context = PlanContext(registry=registry, registry_version=version)
+        grammar = await self._grammar(context, version, all_services)
+        if grammar is None:
+            return
+        prompt_ids = self.engine.tokenizer.encode("warm")
+        await self.engine.generate(
+            prompt_ids, max_new_tokens=1, constrained=True, grammar=grammar
+        )
+
     # ------------------------------------------------------------------ plan
     async def plan(self, intent: str, context: PlanContext) -> Plan:
         await self.ensure_ready()
@@ -196,16 +218,18 @@ class LLMPlanner:
             return grammar
 
     def _build_grammar(self, names, all_services):
-        """Tightest grammar that compiles within budget for this tokenizer:
-        (1) name tries with free-string "in" keys — always fits the byte
-        vocab (dense product) and modest subword vocabs; (2) name tries PLUS
-        "in"-key tries over the registry's schema keys — the form whose
-        sparse product stays small on a 256k SentencePiece vocab (free
-        strings would make most of the vocab active, VERDICT r2 #4); (3)
-        shape-only (None -> the engine's generic grammar)."""
-        try:
-            return build_plan_grammar(self.engine.tokenizer, names)
-        except ValueError as first_err:
+        """Tightest grammar that compiles within budget for this tokenizer.
+        With ``constrain_input_keys="registry"`` (default) the "in" key
+        positions are trie'd over the union of the registry's schema keys —
+        better plans (only keys some service produces/consumes are
+        representable), compact tables on big subword vocabs (free strings
+        would make most of the vocab active, VERDICT r2 #4), and roughly 2x
+        speculation fast-forward (trie'd key characters are mostly FORCED).
+        Fallback ladder on ValueError: with-keys -> without-keys (byte-vocab
+        dense always fits) -> shape-only (None -> the engine's generic
+        grammar)."""
+        keys: list[str] = []
+        if self.config.constrain_input_keys == "registry":
             keys = sorted(
                 {
                     k
@@ -213,26 +237,34 @@ class LLMPlanner:
                     for k in (*s.input_schema.keys(), *s.output_schema.keys())
                 }
             )
-            if keys:
-                try:
-                    g = build_plan_grammar(self.engine.tokenizer, names, input_keys=keys)
-                    log.info(
-                        "grammar: free-string 'in' keys over vocab %d exceeded "
-                        "budget (%s); compiled with %d trie'd schema keys instead",
-                        self.engine.tokenizer.vocab_size, first_err, len(keys),
-                    )
-                    return g
-                except ValueError as e:
+        attempts = []
+        if keys:
+            attempts.append(keys)
+        attempts.append(None)
+        last_err: Exception | None = None
+        for input_keys in attempts:
+            try:
+                g = build_plan_grammar(
+                    self.engine.tokenizer, names, input_keys=input_keys
+                )
+                if input_keys is None and keys:
+                    # Operator asked for key tries but they didn't fit: the
+                    # ~2x speculation win and key validation are OFF for
+                    # this registry version — say so, don't degrade mutely.
                     log.warning(
-                        "registry grammar not compilable even with key tries "
-                        "(%s); using shape-only grammar", e,
+                        "grammar: %d trie'd schema keys exceeded budget (%s); "
+                        "'in' keys are free strings for registry version",
+                        len(keys), last_err,
                     )
-                    return None
-            log.warning(
-                "service names not trie-compilable (%s); using shape-only grammar",
-                first_err,
-            )
-            return None
+                return g
+            except ValueError as e:
+                last_err = e
+                continue
+        log.warning(
+            "registry grammar not compilable (%s); using shape-only grammar",
+            last_err,
+        )
+        return None
 
     def _prompt(self, intent: str, services: list[ServiceRecord], context: PlanContext) -> str:
         """Compact prompt: shortlist + telemetry features + intent, trimmed to

@@ -59,6 +59,16 @@ class ControlPlane:
         ensure = getattr(self.planner, "ensure_ready", None)
         if ensure is not None:
             await ensure()
+        warm = getattr(self.planner, "warm", None)
+        if warm is not None:
+            try:
+                await warm(self.registry)
+            except Exception:  # noqa: BLE001 - warm is best-effort
+                import logging
+
+                logging.getLogger("mcpx.control").exception(
+                    "registry-grammar warmup failed; first plan pays the compile"
+                )
 
     # ------------------------------------------------------------------ plan
     async def plan(self, intent: str, *, use_cache: bool = True) -> tuple[Plan, float]:

@@ -256,3 +256,69 @@ def test_grammar_cache_identity_per_registry_version():
         assert g3.service_names is not None and "extra" in g3.service_names
 
     asyncio.run(go())
+
+
+def test_grammar_ladder_keys_first_then_free_then_shape():
+    """_build_grammar tries key tries first (constrain_input_keys default),
+    falls back to free keys, then shape-only — each transition observable."""
+
+    async def go():
+        reg = await _registry()
+        _, services = await __import__("mcpx.registry.base", fromlist=["stable_snapshot"]).stable_snapshot(reg)
+        p = LLMPlanner(FakeEngine([]), PlannerConfig(kind="llm"))
+        g = p._build_grammar(["fetch", "summarize"], services)
+        assert g is not None
+        # Key tries took effect: a plan using a schema key is accepted...
+        ok = '{"steps":[{"s":"fetch","in":["data"],"next":[]}]}'
+        assert g.is_accept(g.walk(ok))
+        # ...while an out-of-schema key is UNREPRESENTABLE.
+        bad = '{"steps":[{"s":"fetch","in":["nope"],"next":[]}]}'
+        assert g.walk(bad) == g.dead_state
+
+        # With constrain_input_keys=off, free-string keys are accepted.
+        p2 = LLMPlanner(FakeEngine([]), PlannerConfig(kind="llm", constrain_input_keys="off"))
+        g2 = p2._build_grammar(["fetch", "summarize"], services)
+        assert g2.walk(bad) != g2.dead_state
+
+    asyncio.run(go())
+
+
+def test_exclude_builds_grammar_without_excluded_name():
+    """Replan exclusions leave the trie (not just the resolution map):
+    an excluded service's name becomes unrepresentable."""
+
+    async def go():
+        reg = await _registry()
+        from mcpx.registry.base import stable_snapshot
+
+        version, services = await stable_snapshot(reg)
+        p = LLMPlanner(FakeEngine([]), PlannerConfig(kind="llm"))
+        ctx = PlanContext(registry=reg, exclude={"fetch"}, registry_version=version)
+        g = await p._grammar(ctx, version, services)
+        assert g is not None
+        assert g.walk('{"steps":[{"s":"summarize","in":[],"next":[]}]}') != g.dead_state
+        assert g.walk('{"steps":[{"s":"fetch","in":[],"next":[]}]}') == g.dead_state
+        # Cache key includes the exclude set: a no-exclude context gets a
+        # different grammar that still accepts "fetch".
+        ctx2 = PlanContext(registry=reg, registry_version=version)
+        g2 = await p._grammar(ctx2, version, services)
+        assert g2 is not g
+        assert g2.walk('{"steps":[{"s":"fetch","in":[],"next":[]}]}') != g2.dead_state
+
+    asyncio.run(go())
+
+
+def test_warm_runs_one_generate_through_registry_grammar():
+    async def go():
+        reg = await _registry()
+        eng = FakeEngine(["x"])
+        p = LLMPlanner(eng, PlannerConfig(kind="llm"))
+        await p.warm(reg)
+        # One generate went through with the registry grammar attached.
+        assert len(eng.prompts) == 1
+        # Empty registry: warm is a no-op, not an error.
+        empty = InMemoryRegistry()
+        await p.warm(empty)
+        assert len(eng.prompts) == 1
+
+    asyncio.run(go())
