@@ -129,6 +129,15 @@ class _Slab:
         self.constrained = True
         self.temperature = 0.0
         self.grammar: Optional[PlanGrammar] = None
+        # Device-resident copy of (cur, pos, st, emitted, done, budgets,
+        # page_table, out_buf) between segments — None when the host arrays
+        # are authoritative (after any host-side row mutation). Most ticks
+        # chain device state directly into the next segment, transferring
+        # only the done/emitted vectors; full host<->device round trips
+        # happen only on admission/retirement ticks. Matters doubly here:
+        # the dev box reaches its TPU through a tunnel, so each transfer is
+        # a network hop, not a PCIe DMA.
+        self.dev: Optional[tuple] = None
 
     @property
     def n_active(self) -> int:
@@ -517,6 +526,24 @@ class InferenceEngine:
         )
         shardings = tuple(self._named(s) for s in (rs, rs, rs, rs, rs, rs, rs2))
         return jax.device_put(arrs, shardings)
+
+    def _materialize(self, slab: "_Slab") -> None:
+        """Pull the device-resident slab state back into the host arrays so
+        host-side mutation (admission, retirement, failure) is safe; the
+        device copy is invalidated."""
+        if slab.dev is None:
+            return
+        cur, pos, st, e, done, _budgets, _pt, buf = slab.dev
+        cur_h, pos_h, st_h, e_h, done_h, buf_h = jax.device_get(
+            (cur, pos, st, e, done, buf)
+        )
+        slab.cur[:] = cur_h
+        slab.pos[:] = pos_h
+        slab.st[:] = st_h
+        slab.emitted[:] = e_h
+        slab.done[:] = done_h
+        slab.out_buf[:] = buf_h
+        slab.dev = None
 
     def prompt_capacity(self, max_new_tokens: int = 0) -> int:
         """Longest prompt (in tokens) the engine can serve alongside a
@@ -1025,6 +1052,11 @@ class InferenceEngine:
                 )
             )
             t1 = time.monotonic()
+            # Inside the try: _materialize device_gets resident state, and a
+            # tunnel/device failure here must fail THIS cohort's futures and
+            # free its pages (the cohort is not yet merged into slab rows, so
+            # the worker-level handler cannot see it).
+            self._materialize(slab)
         except BaseException as e:  # noqa: BLE001 - fail cohort AND residents
             # Prefill DONATES the pools: after a runtime failure the resident
             # rows' KV may live in already-deleted buffers, so they cannot
@@ -1087,13 +1119,26 @@ class InferenceEngine:
         self.metrics.segment_active_rows.inc(slab.n_active)
         dfa = self._dfa_for(slab.grammar or self.grammar)
         self._seg_counter += 1
+        if slab.dev is None:
+            state = self._put_slab_state(slab) + (
+                self._put(slab.out_buf, self._row_spec(slab.B, 1)),
+            )
+        else:
+            state = slab.dev
+        cur_d, pos_d, st_d, e_d, done_d, budgets_d, pt_d, buf_in = state
         out = self._jit_segment(
             self._params,
             *dfa,
-            *self._put_slab_state(slab),
+            cur_d,
+            pos_d,
+            st_d,
+            e_d,
+            done_d,
+            budgets_d,
+            pt_d,
             self._paged_kv["k"],
             self._paged_kv["v"],
-            self._put(slab.out_buf, self._row_spec(slab.B, 1)),
+            buf_in,
             jax.random.PRNGKey((self._rng_base + self._seg_counter) & 0x7FFFFFFF),
             iters=iters,
             chunk=chunk,
@@ -1102,18 +1147,18 @@ class InferenceEngine:
         )
         cur_d, pos_d, st_d, e_d, done_d, k_p, v_p, buf_d, n_fwd = out
         self._paged_kv = {"k": k_p, "v": v_p}
-        cur, pos, st, e, done, buf, n_fwd = jax.device_get(
-            (cur_d, pos_d, st_d, e_d, done_d, buf_d, n_fwd)
-        )
+        slab.dev = (cur_d, pos_d, st_d, e_d, done_d, budgets_d, pt_d, buf_d)
+        # Small fetch only: full state comes back to the host lazily, on
+        # mutation ticks (_materialize) — not every segment.
+        done, e, n_fwd = jax.device_get((done_d, e_d, n_fwd))
         t1 = time.monotonic()
-        slab.cur[:] = cur
-        slab.pos[:] = pos
-        slab.st[:] = st
-        slab.emitted[:] = e
         slab.done[:] = done
-        slab.out_buf[:] = buf
+        slab.emitted[:] = e
         self.metrics.decode_forwards.inc(int(n_fwd))
 
+        if not any(slab.req[i] is not None and done[i] for i in range(slab.B)):
+            return
+        self._materialize(slab)
         for i in range(slab.B):
             r = slab.req[i]
             if r is None or not slab.done[i]:
@@ -1170,6 +1215,9 @@ class InferenceEngine:
         self._paged_kv = self._init_pools()
 
     def _fail_rows(self, slab: "_Slab", error: BaseException) -> None:
+        # Device copies may be stale or deleted (donated into a failed
+        # call); host state is authoritative from here.
+        slab.dev = None
         for i in range(slab.B):
             r = slab.req[i]
             if r is None:

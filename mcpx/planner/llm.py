@@ -91,6 +91,12 @@ class LLMPlanner:
         ControlPlane.startup; failures are non-fatal (first request then
         pays the compile instead)."""
         await self.ensure_ready()
+        if self.config.constrain_names == "shortlist":
+            # Per-shortlist grammars are keyed by the shortlist itself — the
+            # full-registry grammar warm() would build is never fed to the
+            # decode loop in this mode (column buckets are usually shared
+            # anyway, so the first request's compile risk is low).
+            return
         version, all_services = await stable_snapshot(registry)
         if not all_services:
             return
@@ -208,7 +214,7 @@ class LLMPlanner:
             if cached is not None:
                 return cached
             grammar = await asyncio.to_thread(
-                self._build_grammar, names, all_services
+                self._build_grammar, names, all_services, version
             )
             if grammar is None:
                 return None
@@ -217,7 +223,7 @@ class LLMPlanner:
                 self._grammar_cache.popitem(last=False)
             return grammar
 
-    def _build_grammar(self, names, all_services):
+    def _build_grammar(self, names, all_services, version=None):
         """Tightest grammar that compiles within budget for this tokenizer.
         With ``constrain_input_keys="registry"`` (default) the "in" key
         positions are trie'd over the union of the registry's schema keys —
@@ -253,8 +259,8 @@ class LLMPlanner:
                     # this registry version — say so, don't degrade mutely.
                     log.warning(
                         "grammar: %d trie'd schema keys exceeded budget (%s); "
-                        "'in' keys are free strings for registry version",
-                        len(keys), last_err,
+                        "'in' keys are free strings for registry version %s",
+                        len(keys), last_err, version,
                     )
                 return g
             except ValueError as e:
