@@ -1,0 +1,83 @@
+"""CLI surface: validate/gen-registry round trip, config plumbing, and a
+serve smoke test (the reference's only entry point is a bare uvicorn dev
+block, ``control_plane.py:155-157``)."""
+
+import asyncio
+import json
+
+from mcpx.cli.main import main
+
+
+def test_gen_registry_then_serve_smoke(tmp_path, capsys):
+    reg_path = tmp_path / "registry.json"
+    assert main(["gen-registry", "5", "--out", str(reg_path), "--seed", "3"]) == 0
+    records = json.loads(reg_path.read_text())
+    assert len(records) == 5
+    assert all({"name", "endpoint"} <= set(r) for r in records)
+
+    # The file registry + heuristic planner serve end-to-end over HTTP.
+    async def go():
+        from aiohttp import ClientSession
+        from aiohttp.test_utils import TestServer
+
+        from mcpx.cli.main import _load_config
+        from mcpx.server.app import build_app
+        from mcpx.server.factory import build_control_plane
+
+        import argparse
+
+        args = argparse.Namespace(
+            config=None, registry_file=str(reg_path), planner="heuristic"
+        )
+        cfg = _load_config(args)
+        assert cfg.registry.backend == "file"
+        cp = build_control_plane(cfg)
+        server = TestServer(build_app(cp))
+        await server.start_server()
+        try:
+            async with ClientSession() as s:
+                async with s.get(
+                    f"http://{server.host}:{server.port}/services"
+                ) as r:
+                    body = await r.json()
+                assert r.status == 200 and len(body["services"]) == 5
+                async with s.post(
+                    f"http://{server.host}:{server.port}/plan",
+                    json={"intent": f"use {records[0]['name']}"},
+                ) as r:
+                    assert r.status == 200
+                    plan = await r.json()
+                assert plan["graph"]["nodes"]
+        finally:
+            await server.close()
+
+    asyncio.run(go())
+
+
+def test_validate_accepts_and_rejects(tmp_path, capsys):
+    good = tmp_path / "good.json"
+    good.write_text(
+        json.dumps(
+            {"nodes": [{"name": "a"}, {"name": "b"}], "edges": [{"from": "a", "to": "b"}]}
+        )
+    )
+    assert main(["validate", str(good)]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["valid"] and out["generations"] == [["a"], ["b"]]
+
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"nodes": [{"name": "a"}], "edges": [{"from": "a", "to": "ghost"}]}))
+    assert main(["validate", str(bad)]) == 1
+    out = json.loads(capsys.readouterr().out)
+    assert not out["valid"] and out["problems"]
+
+
+def test_config_file_plumbing(tmp_path):
+    cfg_path = tmp_path / "cfg.json"
+    cfg_path.write_text(json.dumps({"server": {"port": 9123}, "planner": {"kind": "mock"}}))
+    import argparse
+
+    from mcpx.cli.main import _load_config
+
+    cfg = _load_config(argparse.Namespace(config=str(cfg_path), registry_file=None, planner=None))
+    assert cfg.server.port == 9123 and cfg.planner.kind == "mock"
