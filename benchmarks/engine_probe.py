@@ -2,7 +2,23 @@
 """Direct-engine probe: drive InferenceEngine with concurrent constrained
 requests (no HTTP server, no retrieval) and print occupancy/cohort stats —
 the tool for attributing serving throughput between the engine proper and
-the control-plane layers above it."""
+the control-plane layers above it.
+
+Env knobs: PROBE_MODEL (2b|test), PROBE_REQUESTS, PROBE_BATCH, PROBE_TICK,
+PROBE_SPEC, PROBE_KEYS (1 = trie the "in" keys), PROBE_CPU=N (arm an
+N-device virtual CPU platform — env vars alone cannot evict the latched TPU
+backend, and the tunnel blocks a second client in make_c_api_client).
+
+PROBE_SWEEP runs several configs in ONE process — one tunnel session (the
+expensive part on this dev box: a second process blocks on the relay), with
+XLA compiles shared through the persistent compilation cache; each entry
+still builds a fresh engine (weights re-init + trace per config):
+
+    PROBE_SWEEP="tick=2;tick=8;batch=128,tick=2;spec=16" python benchmarks/engine_probe.py
+
+Each ';'-separated entry is a comma list of overrides (tick, spec, batch,
+keys, requests); unset fields fall back to the env/default values.
+"""
 
 import asyncio
 import json
@@ -13,40 +29,51 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 if int(os.environ.get("PROBE_CPU", "0")) > 0:
-    # env vars alone cannot override the axon sitecustomize's latched TPU
-    # backend — and the TPU tunnel admits ONE client (a second process
-    # BLOCKS in make_c_api_client, not errors). Virtual CPU must be armed
-    # through the shared recipe.
     from __graft_entry__ import _force_virtual_cpu
 
     _force_virtual_cpu(int(os.environ["PROBE_CPU"]))
 
+_COUNTERS = (
+    ("fwd", "decode_forwards"),
+    ("tok", "decode_tokens"),
+    ("adm", "admissions"),
+    ("rows", "admitted_rows"),
+    ("segrows", "segment_active_rows"),
+    ("seg", "segments"),
+    ("pft", "prefill_tokens"),
+)
 
-async def main():
+
+def _snap(eng):
+    return {k: getattr(eng.metrics, attr)._value.get() for k, attr in _COUNTERS}
+
+
+async def run_one(*, model: str, n_req: int, batch: int, tick: int, spec: int,
+                  with_keys: bool) -> dict:
     from mcpx.core.config import MCPXConfig
     from mcpx.engine.engine import InferenceEngine
     from mcpx.planner.grammar import build_plan_grammar
 
-    n_req = int(os.environ.get("PROBE_REQUESTS", "256"))
     cfg = MCPXConfig.from_dict(
         {
-            "model": {"size": os.environ.get("PROBE_MODEL", "2b"), "max_seq_len": 2048},
+            "model": {"size": model, "max_seq_len": 2048},
             "engine": {
-                "max_batch_size": int(os.environ.get("PROBE_BATCH", "64")),
+                "max_batch_size": batch,
                 "max_decode_len": 96,
                 "kv_page_size": 64,
                 "max_pages_per_seq": 16,
                 "temperature": 0.0,
                 "use_pallas": True,
-                # The explicit warm round below compiles exactly the buckets
+                # The explicit warm rounds below compile exactly the buckets
                 # the probe exercises; full warmup would compile all of them.
                 "warmup_compile": False,
-                "decode_steps_per_tick": int(os.environ.get("PROBE_TICK", "2")),
-                "speculate_k": int(os.environ.get("PROBE_SPEC", "8")),
+                "decode_steps_per_tick": tick,
+                "speculate_k": spec,
             },
         }
     )
     import jax
+
     if jax.default_backend() == "cpu":
         cfg.engine.use_pallas = False
     eng = InferenceEngine(cfg)
@@ -54,39 +81,33 @@ async def main():
     await eng.start()
     t_start = time.monotonic() - t0
 
-    names = [f"svc-{kind}-{i:04d}" for kind in ("fetch", "rank", "notify", "merge") for i in range(250)]
+    names = [f"svc-{kind}-{i:04d}" for kind in ("fetch", "rank", "notify", "merge")
+             for i in range(250)]
     keys = ["query", "user_id", "order_id", "document", "text", "items", "amount",
             "address", "score", "status", "report", "features", "vector", "summary"]
-    with_keys = os.environ.get("PROBE_KEYS", "1") == "1"
-    grammar = build_plan_grammar(eng.tokenizer, names, input_keys=keys if with_keys else None)
+    grammar = build_plan_grammar(eng.tokenizer, names,
+                                 input_keys=keys if with_keys else None)
     prompt = ("Compose a service DAG. JSON\nServices:\n"
               + "\n".join(f"{n} in:a,b out:c" for n in names[:6])
               + "\nIntent: fetch and rank the things\nJSON:")
     ids = eng.tokenizer.encode(prompt)
 
     # Warm every admission-cohort bucket the timed phase could hit, so no
-    # XLA compile lands inside the measured window (warmup_compile is off —
-    # it would also compile prompt buckets this probe never uses).
+    # XLA compile lands inside the measured window.
     for a in eng._batch_buckets:
         await asyncio.gather(*(eng.generate(ids, max_new_tokens=96, grammar=grammar)
                                for _ in range(a)))
-    m0 = {k: c._value.get() for k, c in
-          [("fwd", eng.metrics.decode_forwards), ("tok", eng.metrics.decode_tokens),
-           ("adm", eng.metrics.admissions), ("rows", eng.metrics.admitted_rows),
-           ("segrows", eng.metrics.segment_active_rows), ("seg", eng.metrics.segments),
-           ("pft", eng.metrics.prefill_tokens)]}
+    m0 = _snap(eng)
     t1 = time.monotonic()
     results = await asyncio.gather(*(eng.generate(ids, max_new_tokens=96, grammar=grammar)
                                      for _ in range(n_req)))
     dt = time.monotonic() - t1
-    m1 = {k: c._value.get() for k, c in
-          [("fwd", eng.metrics.decode_forwards), ("tok", eng.metrics.decode_tokens),
-           ("adm", eng.metrics.admissions), ("rows", eng.metrics.admitted_rows),
-           ("segrows", eng.metrics.segment_active_rows), ("seg", eng.metrics.segments),
-           ("pft", eng.metrics.prefill_tokens)]}
+    m1 = _snap(eng)
     d = {k: m1[k] - m0[k] for k in m0}
     gen = sum(r.generated_tokens for r in results)
-    print(json.dumps({
+    out = {
+        "model": model, "batch": batch, "tick": tick, "spec": spec,
+        "keys": int(with_keys), "requests": n_req,
         "plans_per_sec": round(n_req / dt, 2),
         "elapsed_s": round(dt, 2),
         "startup_s": round(t_start, 1),
@@ -102,8 +123,46 @@ async def main():
         "p50_decode_ms": round(sorted(r.decode_ms for r in results)[n_req // 2], 1),
         "p50_prefill_ms": round(sorted(r.prefill_ms for r in results)[n_req // 2], 1),
         "p50_queue_ms": round(sorted(r.queue_ms for r in results)[n_req // 2], 1),
-    }))
+    }
     await eng.aclose()
+    return out
+
+
+def _base() -> dict:
+    return {
+        "model": os.environ.get("PROBE_MODEL", "2b"),
+        "n_req": int(os.environ.get("PROBE_REQUESTS", "256")),
+        "batch": int(os.environ.get("PROBE_BATCH", "64")),
+        "tick": int(os.environ.get("PROBE_TICK", "2")),
+        "spec": int(os.environ.get("PROBE_SPEC", "8")),
+        "with_keys": os.environ.get("PROBE_KEYS", "1") == "1",
+    }
+
+
+async def main() -> None:
+    sweep = os.environ.get("PROBE_SWEEP", "")
+    configs = []
+    if sweep:
+        for entry in filter(None, (e.strip() for e in sweep.split(";"))):
+            c = _base()
+            for kv in filter(None, entry.split(",")):
+                k, _, v = kv.partition("=")
+                k, v = k.strip(), v.strip()
+                if k == "keys":
+                    c["with_keys"] = v == "1"
+                elif k == "requests":
+                    c["n_req"] = int(v)
+                elif k in ("tick", "spec", "batch"):
+                    c[k] = int(v)
+                elif k == "model":
+                    c["model"] = v
+                else:
+                    raise SystemExit(f"unknown sweep key {k!r}")
+            configs.append(c)
+    else:
+        configs.append(_base())
+    for c in configs:
+        print(json.dumps(await run_one(**c)), flush=True)
 
 
 if __name__ == "__main__":
