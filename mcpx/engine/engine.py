@@ -371,9 +371,11 @@ class InferenceEngine:
         from mcpx.parallel.mesh import make_mesh
 
         ecfg = self.config.engine
-        if ecfg.compilation_cache_dir:
+        if ecfg.compilation_cache_dir and jax.default_backend() not in ("cpu",):
             # Best-effort persistent XLA cache: startup compiles dozens of
             # bucket executables; caching makes warm restarts near-instant.
+            # TPU-only: XLA:CPU AOT entries embed host CPU feature sets and
+            # reloading them across feature mismatches warns of SIGILL.
             try:
                 path = os.path.expanduser(ecfg.compilation_cache_dir)
                 os.makedirs(path, exist_ok=True)
