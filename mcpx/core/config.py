@@ -111,6 +111,13 @@ class EngineConfig:
     grammar_state_budget: int = 16384
     # Largest prompt bucket the startup warmup compiles for.
     warmup_max_len: int = 1024
+    # Shared-prefix KV cache: prompt heads marked by the caller
+    # (GenerateRequest.shared_prefix_len) are prefilled once into read-only
+    # pages referenced by every row's page table; per-request prefill covers
+    # only the suffix. The planner's fixed prompt header makes every /plan
+    # request share ~1 page of KV (VERDICT r2 #6).
+    prefix_cache: bool = True
+    prefix_cache_entries: int = 4
     # Persistent XLA compilation cache directory ("" disables). Engine
     # startup compiles dozens of (batch, length) bucket executables; the
     # cache makes every startup after the first near-instant for unchanged

@@ -77,6 +77,35 @@ class GenerateRequest:
     # Requests sharing a grammar OBJECT can share the slab; the planner
     # caches grammars per registry version so this is the common case.
     grammar: Optional[PlanGrammar] = None
+    # The first `shared_prefix_len` prompt ids are identical across many
+    # requests (the planner's fixed prompt header): the engine prefills them
+    # ONCE into read-only KV pages shared by every row's page table, and
+    # per-request prefill covers only the suffix. 0 disables.
+    shared_prefix_len: int = 0
+
+    def prefix_key(self, page_size: int) -> Optional[tuple]:
+        """Page-aligned shared prefix as the cache key (None = no sharing).
+        Alignment truncates — trailing unaligned prefix ids simply join the
+        suffix — and at least one token must remain in the suffix (the
+        engine samples from the suffix prefill's last logit)."""
+        n = min(self.shared_prefix_len, len(self.prompt_ids) - 1)
+        n = (n // page_size) * page_size
+        if n < page_size:
+            return None
+        return tuple(self.prompt_ids[:n])
+
+
+@dataclasses.dataclass
+class _Prefix:
+    """A cached, prefilled prompt head: `n_tokens` of KV living in `pages`
+    (read-only — rows reference these pages but only ever write at
+    positions >= n_tokens, which land in their own pages). `refs` counts
+    resident rows using it; eviction requires refs == 0."""
+
+    sid: tuple
+    pages: list[int]
+    n_tokens: int
+    refs: int = 0
 
 
 @dataclasses.dataclass
@@ -114,6 +143,7 @@ class _Slab:
         self.pad_id = pad_id
         self.req: list[Optional[GenerateRequest]] = [None] * B
         self.sid: list[Optional[tuple]] = [None] * B
+        self.prefix: list[Optional["_Prefix"]] = [None] * B
         self.cur = np.full((B,), pad_id, np.int32)
         self.pos = np.zeros((B,), np.int32)
         self.st = np.zeros((B,), np.int32)
@@ -163,6 +193,9 @@ class _Slab:
         self.emitted[i] = 0
         self.budgets[i] = 0
         self.page_table[i, :] = 0
+        if self.prefix[i] is not None:
+            self.prefix[i].refs -= 1
+            self.prefix[i] = None
 
 
 class InferenceEngine:
@@ -194,6 +227,7 @@ class InferenceEngine:
         self._params = None
         self._paged_kv = None
         self._dfa_cache: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self._prefix_cache: "OrderedDict[tuple, _Prefix]" = OrderedDict()
         self._seg_counter = 0
         self._seq_counter = 0
         self._last_admit_t = 0.0
@@ -289,7 +323,9 @@ class InferenceEngine:
             self._jit_prefill = None
             self._jit_admit = None
             self._jit_segment = None
+            self._jit_suffix_prefill = None
             self._dfa_cache.clear()
+            self._prefix_cache.clear()
         else:
             log.warning(
                 "engine worker still alive after %.1fs join timeout; keeping "
@@ -307,6 +343,7 @@ class InferenceEngine:
         constrained: bool = True,
         temperature: Optional[float] = None,
         grammar: Optional[PlanGrammar] = None,
+        shared_prefix_len: int = 0,
     ) -> GenerateResult:
         if self.state != "ready":
             raise EngineError(f"engine not ready (state={self.state})")
@@ -320,6 +357,7 @@ class InferenceEngine:
             loop=asyncio.get_running_loop(),
             enqueued_at=time.monotonic(),
             grammar=grammar,
+            shared_prefix_len=shared_prefix_len if ecfg.prefix_cache else 0,
         )
         self._queue.put(req)
         return await req.future
@@ -404,6 +442,9 @@ class InferenceEngine:
         self._jit_admit = jax.jit(
             self._admit_impl, static_argnames=("temperature", "constrained")
         )
+        self._jit_suffix_prefill = jax.jit(
+            self._suffix_prefill_impl, donate_argnames=("paged_k", "paged_v")
+        )
         self._jit_segment = jax.jit(
             self._segment_impl,
             static_argnames=("iters", "chunk", "temperature", "constrained"),
@@ -481,6 +522,19 @@ class InferenceEngine:
                     T=T,
                 )
                 self._paged_kv = {"k": k_p, "v": v_p}
+                if ecfg.prefix_cache:
+                    # Shared-prefix serving prefills SUFFIXES through the
+                    # chunked path; compile it for the same buckets.
+                    last, k_p, v_p = self._jit_suffix_prefill(
+                        self._params,
+                        self._put(tokens, self._row_spec(A, 1)),
+                        self._put(seq_lens, self._row_spec(A)),
+                        self._put(np.zeros((A,), np.int32), self._row_spec(A)),
+                        self._put(table, self._row_spec(A, 1)),
+                        self._paged_kv["k"],
+                        self._paged_kv["v"],
+                    )
+                    self._paged_kv = {"k": k_p, "v": v_p}
             self._jit_admit(
                 *dfa,
                 last,
@@ -547,22 +601,33 @@ class InferenceEngine:
         slab.out_buf[:] = buf_h
         slab.dev = None
 
-    def prompt_capacity(self, max_new_tokens: int = 0) -> int:
+    def prompt_capacity(self, max_new_tokens: int = 0, shared_prefix_len: int = 0) -> int:
         """Longest prompt (in tokens) the engine can serve alongside a
         ``max_new_tokens`` decode budget — the page-capacity/prefill-bucket
         geometry callers should trim to BEFORE submitting. The planner clamps
         its prompt budget to this so the engine's own head-keep safety trim
         (which cannot know which lines matter) never has to engage and the
-        trailing "Intent:"/"JSON:" lines always survive."""
+        trailing "Intent:"/"JSON:" lines always survive.
+
+        ``shared_prefix_len`` mirrors the GenerateRequest field: with a
+        shared prefix the SUFFIX must fit a prefill bucket alongside the
+        prefix's pages, which can shrink total capacity below the no-prefix
+        figure — callers sending a prefix must clamp against this."""
         ecfg = self.config.engine
         capacity = ecfg.max_pages_per_seq * ecfg.kv_page_size
         chunk = self._spec_chunk(True)
         slack = chunk if chunk > 1 else 0
         budget = min(max_new_tokens or ecfg.max_decode_len, max(1, min(ecfg.max_decode_len, capacity - 1 - slack)))
-        eligible = [b for b in self._prefill_buckets if b <= capacity]
+        P = 0
+        if ecfg.prefix_cache and shared_prefix_len:
+            P = (shared_prefix_len // ecfg.kv_page_size) * ecfg.kv_page_size
+        eligible = [b for b in self._prefill_buckets if b + P <= capacity]
+        if P and not eligible:
+            P = 0  # admission falls back to the full-prefill path too
+            eligible = [b for b in self._prefill_buckets if b <= capacity]
         if not eligible:
             return 1
-        return max(1, min(eligible[-1], capacity - budget - slack))
+        return max(1, P + min(eligible[-1], capacity - P - budget - slack))
 
     def _grammar_pad(self) -> int:
         """State-dim pad quantum for grammar device tables. One pad bucket =
@@ -680,6 +745,106 @@ class InferenceEngine:
         )
         last = logits[jnp.arange(B), seq_lens - 1]  # [B, V]
         return last, paged["k"], paged["v"]
+
+    def _suffix_prefill_impl(
+        self, params, tokens, seq_lens, positions, page_table, paged_k, paged_v
+    ):
+        """Prefill only the prompt SUFFIX: one chunked forward whose queries
+        sit at positions ``positions..positions+S-1`` and attend the shared
+        prefix's read-only pages plus themselves (intra-chunk causal) —
+        ``decode_chunk_paged``'s existing contract, at prefill width. Pads
+        past a row's suffix write garbage K/V at positions its decode later
+        overwrites (or the null page); their logits are never read. Uses the
+        fused-jnp chunk attention: the Pallas kernel is tiled for
+        speculation-width chunks, and prefill-width attention is a small
+        fraction of the suffix matmuls anyway."""
+        cfg = self.model_cfg
+        A = tokens.shape[0]
+        logits_all, kv = decode_chunk_paged(
+            params,
+            cfg,
+            tokens,
+            positions,
+            page_table,
+            {"k": paged_k, "v": paged_v},
+            use_pallas=False,
+            interpret=self.config.engine.interpret,
+        )
+        last = logits_all[jnp.arange(A), seq_lens - 1]  # [A, V]
+        return last, kv["k"], kv["v"]
+
+    def _ensure_prefix(self, key: tuple) -> Optional["_Prefix"]:
+        """Return the cached prefilled prompt head for ``key``, building it
+        on miss (one [1, T] prefill into dedicated pages). None when it
+        cannot be built right now (page pressure, capacity) — callers fall
+        back to full prefill. Worker-thread only."""
+        ecfg = self.config.engine
+        hit = self._prefix_cache.get(key)
+        if hit is not None:
+            self._prefix_cache.move_to_end(key)
+            self.metrics.prefix_hits.inc()
+            return hit
+        P = len(key)
+        capacity = ecfg.max_pages_per_seq * ecfg.kv_page_size
+        # The prefix must leave room for a minimal suffix + decode budget,
+        # and must itself fit a prefill bucket — checked BEFORE any pages
+        # are allocated (a raise here must not leak).
+        eligible = tuple(b for b in self._prefill_buckets if b <= capacity)
+        if (
+            not eligible
+            or P > eligible[-1]
+            or P + self._prefill_buckets[0] + ecfg.max_decode_len > capacity
+        ):
+            return None
+        T = _bucket(P, eligible)
+        if not self._allocator.can_allocate(P):
+            self._evict_prefixes(P)
+            if not self._allocator.can_allocate(P):
+                return None
+        self.metrics.prefix_misses.inc()
+        self._seq_counter += 1
+        sid = ("prefix", self._seq_counter)
+        pages = self._allocator.allocate(sid, P)
+        table = np.zeros((1, ecfg.max_pages_per_seq), np.int32)
+        table[0, : len(pages)] = pages
+        tokens = np.full((1, T), self.tokenizer.pad_id, np.int32)
+        tokens[0, :P] = key
+        try:
+            last, k_p, v_p = self._jit_prefill(
+                self._params,
+                self._put(tokens, self._row_spec(1, 1)),
+                self._put(np.asarray([P], np.int32), self._row_spec(1)),
+                self._paged_kv["k"],
+                self._paged_kv["v"],
+                self._put(table, self._row_spec(1, 1)),
+                T=T,
+            )
+            self._paged_kv = {"k": k_p, "v": v_p}
+            del last
+        except BaseException:
+            self._allocator.free(sid)
+            raise
+        pfx = _Prefix(sid=sid, pages=pages, n_tokens=P)
+        self._prefix_cache[key] = pfx
+        self._evict_prefixes(exclude=key)
+        return pfx
+
+    def _evict_prefixes(self, need_tokens: int = 0, exclude: Optional[tuple] = None) -> None:
+        """Drop unreferenced cached prefixes (LRU first) while over the
+        entry cap, or until ``need_tokens`` worth of pages can be allocated.
+        ``exclude`` protects a just-built, not-yet-referenced entry from
+        being evicted before its caller can use it."""
+        max_entries = max(0, self.config.engine.prefix_cache_entries)
+        for key in list(self._prefix_cache):
+            over = len(self._prefix_cache) > max_entries
+            starved = need_tokens and not self._allocator.can_allocate(need_tokens)
+            if not (over or starved):
+                return
+            pfx = self._prefix_cache[key]
+            if pfx.refs > 0 or key == exclude:
+                continue
+            self._allocator.free(pfx.sid)
+            del self._prefix_cache[key]
 
     def _segment_impl(
         self,
@@ -963,12 +1128,60 @@ class InferenceEngine:
             # limited to one per admit_max_wait_s, full ones go immediately.
             return
 
+    # --- shared-prefix resolution (the cohort shares one prefix key; the
+    # planner's fixed prompt header makes this the common case)
+        head_req = next((r for r in pending if slab.compatible(r)), None)
+        if head_req is None:
+            return
+        prefix: Optional[_Prefix] = None
+        head_key = (
+            head_req.prefix_key(ecfg.kv_page_size) if ecfg.prefix_cache else None
+        )
+        if head_key is not None:
+            try:
+                prefix = self._ensure_prefix(head_key)
+            except BaseException as e:  # noqa: BLE001 - prefill donated pools
+                log.exception("prefix build failed; failing resident rows")
+                self._fail_rows(slab, e)
+                self._reset_pools()
+                return
+            if prefix is None:
+                head_key = None  # unbuildable now (pages/capacity): full path
+        if prefix is not None:
+            # Admission hold: page-pressure eviction inside the cohort loop
+            # must never free the prefix this very admission is wiring into
+            # page tables (rows take their own refs only at merge time).
+            prefix.refs += 1
+        try:
+            self._admit_cohort(slab, pending, prefix, head_key)
+        finally:
+            if prefix is not None:
+                prefix.refs -= 1
+
+    def _admit_cohort(
+        self,
+        slab: "_Slab",
+        pending: "deque[GenerateRequest]",
+        prefix: Optional["_Prefix"],
+        head_key: Optional[tuple],
+    ) -> None:
+        ecfg = self.config.engine
+        tok = self.tokenizer
+        free = slab.free_rows()
+        P = prefix.n_tokens if prefix is not None else 0
+
     # --- per-request geometry
         spec_chunk = self._spec_chunk(slab.constrained)
         slack = spec_chunk if spec_chunk > 1 else 0
         capacity = ecfg.max_pages_per_seq * ecfg.kv_page_size
-        budget_cap = min(slab.steps, capacity - 1 - slack)
-        eligible = tuple(b for b in self._prefill_buckets if b <= capacity)
+        budget_cap = min(slab.steps, capacity - 1 - slack - P)
+        eligible = tuple(b for b in self._prefill_buckets if b + P <= capacity)
+        if (budget_cap < 1 or not eligible) and prefix is not None:
+            # The prefix left no room for suffix + decode on this geometry:
+            # serve without it rather than failing the queue.
+            prefix, head_key, P = None, None, 0
+            budget_cap = min(slab.steps, capacity - 1 - slack)
+            eligible = tuple(b for b in self._prefill_buckets if b <= capacity)
         if budget_cap < 1 or not eligible:
             err = EngineError(
                 f"page capacity {capacity} (max_pages_per_seq*kv_page_size) "
@@ -980,23 +1193,31 @@ class InferenceEngine:
             return
 
         cohort: list[GenerateRequest] = []
-        prompts: list[list[int]] = []
+        prompts: list[list[int]] = []  # SUFFIX ids (whole prompt when P == 0)
         budgets: list[int] = []
         defer: list[GenerateRequest] = []
         while pending and len(cohort) < len(free):
             r = pending.popleft()
-            if not slab.compatible(r):
+            if not slab.compatible(r) or (
+                head_key is not None and r.prefix_key(ecfg.kv_page_size) != head_key
+            ):
+                # Different sampling config or different shared prefix: wait
+                # for a later cohort (prefix only shapes ADMISSION; rows
+                # with different prefixes decode side by side just fine).
                 defer.append(r)
                 continue
             budget = max(1, min(r.max_new_tokens, budget_cap))
             # Keep the prompt HEAD on overflow — the planner ranks its best
             # candidate services first and trims the tail, and the engine
             # must agree (VERDICT r2 weak #4: two layers, two policies).
-            longest = min(eligible[-1], capacity - budget - slack)
-            ids = r.prompt_ids[:longest] or [tok.bos_id]
-            if not self._allocator.can_allocate(len(ids) + budget + slack):
-                pending.appendleft(r)  # FIFO: wait for pages, don't reorder
-                break
+            longest = min(eligible[-1], capacity - P - budget - slack)
+            ids = r.prompt_ids[P : P + longest] or [tok.bos_id]
+            need = len(ids) + budget + slack
+            if not self._allocator.can_allocate(need):
+                self._evict_prefixes(need)
+                if not self._allocator.can_allocate(need):
+                    pending.appendleft(r)  # FIFO: wait for pages, don't reorder
+                    break
             cohort.append(r)
             prompts.append(ids)
             budgets.append(budget)
@@ -1005,6 +1226,7 @@ class InferenceEngine:
         if not cohort:
             return
 
+        n_pp = P // ecfg.kv_page_size
         A = _bucket(len(cohort), self._batch_buckets)
         T = _bucket(max(len(p) for p in prompts), eligible)
         tokens = np.full((A, T), tok.pad_id, np.int32)
@@ -1022,22 +1244,39 @@ class InferenceEngine:
             self._seq_counter += 1
             sid = ("seq", self._seq_counter)
             pages = self._allocator.allocate(sid, len(ids) + budget + slack)
-            table[j, : len(pages)] = pages
+            if prefix is not None:
+                table[j, :n_pp] = prefix.pages
+            table[j, n_pp : n_pp + len(pages)] = pages
             sids.append(sid)
         self.metrics.kv_page_utilization.set(self._allocator.stats().utilization)
 
         try:
             t0 = time.monotonic()
             dfa = self._dfa_for(slab.grammar or self.grammar)
-            last_logits, k_p, v_p = self._jit_prefill(
-                self._params,
-                self._put(tokens, self._row_spec(A, 1)),
-                self._put(seq_lens, self._row_spec(A)),
-                self._paged_kv["k"],
-                self._paged_kv["v"],
-                self._put(table, self._row_spec(A, 1)),
-                T=T,
-            )
+            if prefix is not None:
+                # Suffix-only prefill: one chunked forward whose queries
+                # start at position P and attend the shared prefix pages +
+                # themselves (decode_chunk_paged's contract) — the prefix's
+                # FLOPs are paid once per cache entry, not per request.
+                last_logits, k_p, v_p = self._jit_suffix_prefill(
+                    self._params,
+                    self._put(tokens, self._row_spec(A, 1)),
+                    self._put(seq_lens, self._row_spec(A)),
+                    self._put(np.full((A,), P, np.int32), self._row_spec(A)),
+                    self._put(table, self._row_spec(A, 1)),
+                    self._paged_kv["k"],
+                    self._paged_kv["v"],
+                )
+            else:
+                last_logits, k_p, v_p = self._jit_prefill(
+                    self._params,
+                    self._put(tokens, self._row_spec(A, 1)),
+                    self._put(seq_lens, self._row_spec(A)),
+                    self._paged_kv["k"],
+                    self._paged_kv["v"],
+                    self._put(table, self._row_spec(A, 1)),
+                    T=T,
+                )
             # Pools were donated to prefill: point at the live buffers
             # immediately so an exception below can't leave stale handles.
             self._paged_kv = {"k": k_p, "v": v_p}
@@ -1099,7 +1338,7 @@ class InferenceEngine:
             slab.req[i] = r
             slab.sid[i] = sids[j]
             slab.cur[i] = cur0[j]
-            slab.pos[i] = seq_lens[j]
+            slab.pos[i] = P + seq_lens[j]
             slab.st[i] = st0[j]
             slab.emitted[i] = 1
             slab.done[i] = False
@@ -1110,6 +1349,9 @@ class InferenceEngine:
             slab.queue_ms[i] = (t0 - r.enqueued_at) * 1e3
             slab.prefill_ms[i] = prefill_ms
             slab.t_decode0[i] = t1
+            if prefix is not None:
+                prefix.refs += 1
+                slab.prefix[i] = prefix
         self.metrics.kv_page_utilization.set(self._allocator.stats().utilization)
         self.metrics.batch_occupancy.set(slab.n_active)
 
@@ -1213,7 +1455,13 @@ class InferenceEngine:
         ``self._paged_kv`` pointing at already-deleted buffers, which would
         wedge every subsequent request while /healthz still says ready. All
         resident rows were failed first, so the cached KV content is
-        worthless — fresh zeroed pools restore service."""
+        worthless — fresh zeroed pools restore service. Cached prefixes'
+        KV lived in the OLD pools: serving them against zeroed pools would
+        silently corrupt every later prefix-shared generation, so they are
+        dropped (and rebuilt on next use)."""
+        for pfx in self._prefix_cache.values():
+            self._allocator.free(pfx.sid)
+        self._prefix_cache.clear()
         self._paged_kv = self._init_pools()
 
     def _fail_rows(self, slab: "_Slab", error: BaseException) -> None:

@@ -36,6 +36,13 @@ from mcpx.registry.base import ServiceRecord, stable_snapshot
 
 log = logging.getLogger("mcpx.planner.llm")
 
+# Fixed prompt header — byte-identical for every request against any
+# registry, which is what makes it shareable as one prefilled KV prefix.
+_PROMPT_HEADER = (
+    'Compose a service DAG. JSON {"steps":[{"s":svc,"in":[keys],"next":[svcs]}]}'
+    "\nServices:\n"
+)
+
 
 
 class LLMPlanner:
@@ -126,13 +133,28 @@ class LLMPlanner:
             s.name: s for s in all_services if s.name not in context.exclude
         }
         grammar = await self._grammar(context, version, all_services)
-        prompt = self._prompt(intent, services, context)
-        prompt_ids = self.engine.tokenizer.encode(prompt)
+        # Tokenize the fixed header separately so its ids are IDENTICAL
+        # across requests whatever follows (subword tokenizers are not
+        # concatenation-safe at the boundary) — the engine then serves the
+        # header's KV from one shared read-only page set instead of
+        # re-prefilling it per request (VERDICT r2 #6). The prompt budget is
+        # clamped against the PREFIX-path capacity, which bucket geometry
+        # can make smaller than the full-prefill one.
+        tok = self.engine.tokenizer
+        prefix_ids = tok.encode(_PROMPT_HEADER)
+        prompt, head_chars = self._prompt(
+            intent, services, context, prefix_len=len(prefix_ids)
+        )
+        assert prompt[:head_chars] == _PROMPT_HEADER
+        prompt_ids = prefix_ids + tok.encode(prompt[head_chars:], bos=False)
 
         last_problems: list[str] = []
         for attempt in range(self.config.max_plan_retries + 1):
             res = await self.engine.generate(
-                prompt_ids, constrained=True, grammar=grammar
+                prompt_ids,
+                constrained=True,
+                grammar=grammar,
+                shared_prefix_len=len(prefix_ids),
             )
             try:
                 plan = Plan.from_json(res.text)
@@ -272,13 +294,22 @@ class LLMPlanner:
         )
         return None
 
-    def _prompt(self, intent: str, services: list[ServiceRecord], context: PlanContext) -> str:
+    def _prompt(
+        self,
+        intent: str,
+        services: list[ServiceRecord],
+        context: PlanContext,
+        prefix_len: int = 0,
+    ) -> tuple[str, int]:
         """Compact prompt: shortlist + telemetry features + intent, trimmed to
-        ``max_prompt_tokens`` (byte tokenizer: 1 token ≈ 1 char)."""
-        lines = [
-            'Compose a service DAG. JSON {"steps":[{"s":svc,"in":[keys],"next":[svcs]}]}',
-            "Services:",
-        ]
+        ``max_prompt_tokens`` (byte tokenizer: 1 token ≈ 1 char). Returns
+        (text, header_chars) where the first ``header_chars`` are the fixed
+        instruction header (``_PROMPT_HEADER``) shared verbatim by every
+        request — the engine's shared-prefix KV cache keys on it.
+        ``prefix_len`` is the header's token length, used to clamp against
+        the engine's prefix-path capacity."""
+        header = _PROMPT_HEADER[:-1]  # strip trailing \n; joined back below
+        lines = header.split("\n")
         for s in services:
             feat = ""
             st = context.telemetry.get(s.name)
@@ -308,7 +339,10 @@ class LLMPlanner:
         capacity_fn = getattr(self.engine, "prompt_capacity", None)
         budget = self.config.max_prompt_tokens
         if capacity_fn is not None:
-            budget = min(budget, capacity_fn() - 1)
+            try:
+                budget = min(budget, capacity_fn(0, prefix_len) - 1)
+            except TypeError:  # older/fake engines: no prefix parameter
+                budget = min(budget, capacity_fn() - 1)
         if len(text) > budget:
             # Drop whole service lines from the tail of the list (lowest
             # retrieval rank) until the prompt fits; intent always survives.
@@ -321,7 +355,10 @@ class LLMPlanner:
                 kept.append(line)
                 fixed += len(line) + 1
             text = "\n".join(head + kept + lines[-2:])
-        return text
+        # Fixed header = the instruction + "Services:" lines INCLUDING the
+        # trailing newline, identical for every request against any registry.
+        header_chars = len(lines[0]) + 1 + len(lines[1]) + 1
+        return text, header_chars
 
     def _resolve(self, plan: Plan, by_name: dict[str, ServiceRecord]) -> None:
         """Fill endpoints/fallbacks/costs from the registry (LLM output is

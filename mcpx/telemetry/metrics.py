@@ -99,6 +99,16 @@ class Metrics:
         self.segments = Counter(
             "mcpx_engine_segments_total", "Decode segments run", registry=self.registry
         )
+        self.prefix_hits = Counter(
+            "mcpx_engine_prefix_cache_hits_total",
+            "Admissions served from a cached shared-prefix KV entry",
+            registry=self.registry,
+        )
+        self.prefix_misses = Counter(
+            "mcpx_engine_prefix_cache_misses_total",
+            "Shared-prefix KV entries built",
+            registry=self.registry,
+        )
         self.prefill_tokens = Counter(
             "mcpx_engine_prefill_tokens_total",
             "Real (unpadded) prompt tokens prefilled — with decode_tokens this "

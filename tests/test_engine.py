@@ -345,3 +345,56 @@ def test_engine_multichip_matches_single_chip():
         assert kspec8[0] == "model", f"KV pools not sharded over model: {kspec8}"
 
     asyncio.run(go())
+
+
+def test_shared_prefix_matches_full_prefill():
+    """Shared-prefix serving is exact: with a common prompt head cached in
+    read-only pages and only suffixes prefilled, greedy outputs are byte-
+    identical to full per-request prefill — and the prefix pages are
+    refcounted/evictable, never leaked."""
+
+    async def go():
+        eng_full = make_engine(prefix_cache=False)
+        eng_pfx = make_engine(prefix_cache=True)
+        await eng_full.start()
+        await eng_pfx.start()
+        try:
+            tok = eng_full.tokenizer
+            header = "Compose a service DAG. JSON schema blah\nServices:\n"
+            prefix_ids = tok.encode(header)
+            prompts = [
+                prefix_ids + tok.encode(f"svc-{i} in:a out:b\nIntent: do thing {i}\nJSON:", bos=False)
+                for i in range(5)
+            ]
+            full = [
+                await eng_full.generate(p, max_new_tokens=32) for p in prompts
+            ]
+            shared = await asyncio.gather(
+                *(
+                    eng_pfx.generate(
+                        p, max_new_tokens=32, shared_prefix_len=len(prefix_ids)
+                    )
+                    for p in prompts
+                )
+            )
+            for f, s in zip(full, shared):
+                assert s.text == f.text, (s.text, f.text)
+            # Exactly one prefix entry was built and is now unreferenced.
+            assert len(eng_pfx._prefix_cache) == 1
+            (pfx,) = eng_pfx._prefix_cache.values()
+            assert pfx.refs == 0
+            assert pfx.n_tokens % eng_pfx.config.engine.kv_page_size == 0
+            # Allocator: only the prefix's pages remain held.
+            stats = eng_pfx._allocator.stats()
+            assert stats.sequences == 1
+            eng_pfx._allocator.check_invariants()
+            # Eviction drops it once unreferenced and over budget.
+            eng_pfx.config.engine.prefix_cache_entries = 0
+            eng_pfx._evict_prefixes()
+            assert len(eng_pfx._prefix_cache) == 0
+            assert eng_pfx._allocator.stats().sequences == 0
+        finally:
+            await eng_full.aclose()
+            await eng_pfx.aclose()
+
+    asyncio.run(go())
