@@ -618,16 +618,23 @@ class InferenceEngine:
         chunk = self._spec_chunk(True)
         slack = chunk if chunk > 1 else 0
         budget = min(max_new_tokens or ecfg.max_decode_len, max(1, min(ecfg.max_decode_len, capacity - 1 - slack)))
+        full_eligible = [b for b in self._prefill_buckets if b <= capacity]
+        if not full_eligible:
+            return 1
+        full_cap = max(1, min(full_eligible[-1], capacity - budget - slack))
         P = 0
         if ecfg.prefix_cache and shared_prefix_len:
             P = (shared_prefix_len // ecfg.kv_page_size) * ecfg.kv_page_size
+        if not P:
+            return full_cap
         eligible = [b for b in self._prefill_buckets if b + P <= capacity]
-        if P and not eligible:
-            P = 0  # admission falls back to the full-prefill path too
-            eligible = [b for b in self._prefill_buckets if b <= capacity]
         if not eligible:
-            return 1
-        return max(1, P + min(eligible[-1], capacity - P - budget - slack))
+            return full_cap  # admission falls back to the full path too
+        prefix_cap = max(1, P + min(eligible[-1], capacity - P - budget - slack))
+        # Admission may fall back to full prefill at runtime (page pressure,
+        # unbuildable prefix), whose head-keep trim would cut the prompt
+        # TAIL — so the caller must fit the WORST of the two paths.
+        return min(full_cap, prefix_cap)
 
     def _grammar_pad(self) -> int:
         """State-dim pad quantum for grammar device tables. One pad bucket =

@@ -21,6 +21,7 @@ North-star replacement for the reference's OpenAI round-trip (reference
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
 from collections import OrderedDict
 from typing import Optional
@@ -156,12 +157,16 @@ class LLMPlanner:
                 grammar=grammar,
                 shared_prefix_len=len(prefix_ids),
             )
+            repaired = False
             try:
                 plan = Plan.from_json(res.text)
             except PlanValidationError as e:
-                last_problems = e.problems
-                log.info("plan attempt %d rejected: %s", attempt, e.problems[:3])
-                continue
+                plan = self._repair(res.text)
+                if plan is None:
+                    last_problems = e.problems
+                    log.info("plan attempt %d rejected: %s", attempt, e.problems[:3])
+                    continue
+                repaired = True
             unknown = [n.service for n in plan.nodes if n.service not in by_name]
             if unknown:
                 last_problems = [f"unknown service(s): {unknown}"]
@@ -171,7 +176,11 @@ class LLMPlanner:
             plan.intent = intent
             plan.origin = "llm"
             if self.config.explain:
-                plan.explanation = self._explain(plan, attempt)
+                plan.explanation = self._explain(plan, attempt) + (
+                    " [repaired: dangling/backward next-references pruned]"
+                    if repaired
+                    else ""
+                )
             return plan
 
         log.warning(
@@ -359,6 +368,48 @@ class LLMPlanner:
         # trailing newline, identical for every request against any registry.
         header_chars = len(lines[0]) + 1 + len(lines[1]) + 1
         return text, header_chars
+
+    def _repair(self, text: str) -> Optional[Plan]:
+        """Bounded, deterministic repair of a grammar-valid but
+        DAG-invalid decode: drop duplicate steps (keep first) and keep only
+        FORWARD next-references to surviving steps — a dangling or backward
+        "next" becomes no edge instead of discarding the whole LLM plan
+        (the cause of most heuristic fallbacks at large registries: the
+        trie guarantees names exist in the REGISTRY, not among the emitted
+        steps). Forward-only edges make the result acyclic by construction.
+        Returns None when the text isn't even parseable JSON (budget-
+        truncated prefix) or repair still fails validation."""
+        try:
+            obj = json.loads(text)
+        except json.JSONDecodeError:
+            return None
+        steps = obj.get("steps") if isinstance(obj, dict) else None
+        if not isinstance(steps, list):
+            return None
+        seen: dict[str, int] = {}
+        kept = []
+        for step in steps:
+            if not isinstance(step, dict) or step.get("s") in seen:
+                continue
+            seen[step.get("s")] = len(kept)
+            kept.append(dict(step))
+        # Stage 1 — minimal: drop duplicate steps and DANGLING references
+        # only; backward edges are legal (Plan.validate allows any acyclic
+        # orientation) and may encode real dependencies, so they survive.
+        for step in kept:
+            step["next"] = [n for n in (step.get("next") or []) if n in seen]
+        try:
+            return Plan.from_json(json.dumps({"steps": kept}))
+        except PlanValidationError:
+            pass
+        # Stage 2 — the remaining defect is a cycle/self-loop: keep only
+        # FORWARD references (emission order), acyclic by construction.
+        for idx, step in enumerate(kept):
+            step["next"] = [n for n in step["next"] if seen[n] > idx]
+        try:
+            return Plan.from_json(json.dumps({"steps": kept}))
+        except PlanValidationError:
+            return None
 
     def _resolve(self, plan: Plan, by_name: dict[str, ServiceRecord]) -> None:
         """Fill endpoints/fallbacks/costs from the registry (LLM output is

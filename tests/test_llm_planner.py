@@ -322,3 +322,33 @@ def test_warm_runs_one_generate_through_registry_grammar():
         assert len(eng.prompts) == 1
 
     asyncio.run(go())
+
+
+def test_repair_prunes_dangling_and_backward_next():
+    """Grammar-valid decodes whose 'next' references name un-emitted or
+    earlier steps are REPAIRED (forward edges to kept steps only) instead of
+    discarded to the heuristic — the main fallback cause at 1k-service
+    registries (trie guarantees registry membership, not step membership)."""
+
+    async def go():
+        reg = await _registry()
+        # "ghost" exists in the registry? No — but repair drops the EDGE, not
+        # the step; both steps exist in the registry here while "next" points
+        # at an un-emitted service and backwards.
+        wire = (
+            '{"steps":['
+            '{"s":"fetch","in":[],"next":["summarize","fetch"]},'
+            '{"s":"summarize","in":["data"],"next":["fetch"]},'
+            '{"s":"summarize","in":[],"next":[]}'
+            "]}"
+        )
+        eng = FakeEngine([wire])
+        p = LLMPlanner(eng, PlannerConfig(kind="llm", max_plan_retries=0))
+        plan = await p.plan("x", PlanContext(registry=reg))
+        assert plan.origin == "llm"
+        assert [n.name for n in plan.nodes] == ["fetch", "summarize"]  # dup dropped
+        assert len(plan.edges) == 1  # forward fetch->summarize only
+        assert plan.edges[0].src == "fetch" and plan.edges[0].dst == "summarize"
+        assert "repaired" in plan.explanation
+
+    asyncio.run(go())
