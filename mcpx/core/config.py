@@ -67,8 +67,17 @@ class EngineConfig:
     # newly-arrived requests into free slab rows (continuous batching), so
     # this bounds admission latency: smaller = lower p50 under load, larger
     # = fewer host round-trips per token. With speculation each forward
-    # covers up to speculate_k tokens.
-    decode_steps_per_tick: int = 2
+    # covers up to speculate_k tokens. Sized so a segment's compute (~4
+    # weight-bound forwards) roughly covers one host<->device round trip:
+    # the pipelined worker overlaps the flag fetch with the next segment.
+    decode_steps_per_tick: int = 4
+    # Decode segments kept in flight before the worker blocks on the oldest
+    # one's done-flags. 1 = fetch the segment just dispatched (no overlap).
+    # 2 = fetch the PREVIOUS segment's flags while the current one computes,
+    # hiding the host<->device round trip (which dominates when the chip
+    # sits behind a network tunnel: ~72ms measured vs ~7ms per async
+    # dispatch). Retirement lags admission by depth-1 segments.
+    pipeline_depth: int = 2
     # Once the head of the pending line has waited this long behind an
     # incompatible slab (different grammar/temperature), stop admitting new
     # rows so the slab drains and the head can run.
@@ -286,6 +295,10 @@ class MCPXConfig:
             problems.append("engine mesh axes must be >= 0 (0 = auto)")
         if self.engine.max_batch_size < 1:
             problems.append("engine.max_batch_size must be >= 1")
+        if self.engine.pipeline_depth < 1:
+            problems.append("engine.pipeline_depth must be >= 1")
+        if self.engine.decode_steps_per_tick < 1:
+            problems.append("engine.decode_steps_per_tick must be >= 1")
         if not 0.0 < self.telemetry.ewma_alpha <= 1.0:
             problems.append("telemetry.ewma_alpha must be in (0, 1]")
         if self.retrieval.top_k < 1:

@@ -13,6 +13,13 @@ replacement: an in-process serving stack where
     rows — *continuous batching*: a request never waits for a previous
     batch to run to completion, only for the next segment boundary
     (SURVEY.md §3.3; the p50 lever VERDICT r2 ranked #1);
+  - the worker is **pipelined** (``pipeline_depth``): it dispatches the
+    next segment BEFORE fetching the previous one's done-flags, so the
+    host→device round trip (~72 ms measured through the dev tunnel, vs
+    ~7 ms per async dispatch) rides on top of compute the device is
+    already doing. Slab-row mutation happens on device via a jitted merge
+    scatter; the host never materialises full state. Per-row generation
+    counters keep lagged done-flags from retiring a re-admitted row;
   - within a segment, grammar masking, speculation fast-forward, sampling
     and KV writes all happen on-device with zero host round-trips per
     token; pools are donated so decode updates in place;
@@ -144,6 +151,11 @@ class _Slab:
         self.req: list[Optional[GenerateRequest]] = [None] * B
         self.sid: list[Optional[tuple]] = [None] * B
         self.prefix: list[Optional["_Prefix"]] = [None] * B
+        # Per-row generation counter, bumped at admission. In-flight segment
+        # outputs carry a snapshot: a done-flag from a segment dispatched
+        # BEFORE the row was re-admitted must never retire the row's NEW
+        # request (the pipelined worker reads flags D segments late).
+        self.gen = np.zeros((B,), np.int64)
         self.cur = np.full((B,), pad_id, np.int32)
         self.pos = np.zeros((B,), np.int32)
         self.st = np.zeros((B,), np.int32)
@@ -160,13 +172,13 @@ class _Slab:
         self.temperature = 0.0
         self.grammar: Optional[PlanGrammar] = None
         # Device-resident copy of (cur, pos, st, emitted, done, budgets,
-        # page_table, out_buf) between segments — None when the host arrays
-        # are authoritative (after any host-side row mutation). Most ticks
-        # chain device state directly into the next segment, transferring
-        # only the done/emitted vectors; full host<->device round trips
-        # happen only on admission/retirement ticks. Matters doubly here:
-        # the dev box reaches its TPU through a tunnel, so each transfer is
-        # a network hop, not a PCIe DMA.
+        # page_table, out_buf) between segments — None only at startup and
+        # after a failure reset (host arrays are then authoritative). All
+        # row mutation (admission, retirement pt-zeroing) happens ON DEVICE
+        # via the jitted merge scatter; the host only ever reads back the
+        # small flag vectors + out_buf of a LAGGED segment. Matters doubly
+        # here: the dev box reaches its TPU through a tunnel, so each
+        # blocking transfer is a ~72ms network round trip, not a PCIe DMA.
         self.dev: Optional[tuple] = None
 
     @property
@@ -192,6 +204,7 @@ class _Slab:
         self.st[i] = 0
         self.emitted[i] = 0
         self.budgets[i] = 0
+        self.gen[i] += 1
         self.page_table[i, :] = 0
         if self.prefix[i] is not None:
             self.prefix[i].refs -= 1
@@ -228,6 +241,16 @@ class InferenceEngine:
         self._paged_kv = None
         self._dfa_cache: "OrderedDict[tuple, tuple]" = OrderedDict()
         self._prefix_cache: "OrderedDict[tuple, _Prefix]" = OrderedDict()
+        # Pipelined segment outputs awaiting their (lagged) flag fetch:
+        # entries are (done, emitted, out_buf, n_fwd device handles,
+        # gen snapshot, dispatch wall time). Worker thread only.
+        self._inflight: "deque[tuple]" = deque()
+        # Rows retired on the host whose DEVICE page-table rows still point
+        # at freed pages; zeroed (scatter to the null page) in the next
+        # merge dispatch — which always happens before freed pages can be
+        # reused, because reuse requires an admission and every admission
+        # dispatches a merge.
+        self._dirty_rows: set[int] = set()
         self._seg_counter = 0
         self._seq_counter = 0
         self._last_admit_t = 0.0
@@ -324,6 +347,8 @@ class InferenceEngine:
             self._jit_admit = None
             self._jit_segment = None
             self._jit_suffix_prefill = None
+            self._jit_merge = None
+            self._inflight.clear()
             self._dfa_cache.clear()
             self._prefix_cache.clear()
         else:
@@ -445,11 +470,18 @@ class InferenceEngine:
         self._jit_suffix_prefill = jax.jit(
             self._suffix_prefill_impl, donate_argnames=("paged_k", "paged_v")
         )
+        # out_buf is NOT donated: the pipelined worker reads a LAGGED
+        # segment's out_buf after newer segments were already dispatched —
+        # donation would invalidate the handle it still has to fetch. The
+        # copy is [B, steps] int32, noise next to the KV pools.
         self._jit_segment = jax.jit(
             self._segment_impl,
             static_argnames=("iters", "chunk", "temperature", "constrained"),
-            donate_argnames=("paged_k", "paged_v", "out_buf"),
+            donate_argnames=("paged_k", "paged_v"),
         )
+        # Merge donates NOTHING: its inputs are the newest segment's output
+        # handles, which the newest in-flight entry still needs readable.
+        self._jit_merge = jax.jit(self._merge_impl)
         self._slab = _Slab(
             ecfg.max_batch_size,
             ecfg.max_decode_len,
@@ -561,6 +593,11 @@ class InferenceEngine:
             constrained=True,
         )
         self._paged_kv = {"k": out[5], "v": out[6]}
+        # Compile the admission/retirement merge scatter too (row 0 is free,
+        # so merging its clear-values is a semantic no-op); the resulting
+        # device state equals the host state and stays usable for serving.
+        self._dirty_rows.add(0)
+        self._dispatch_merge(slab, [])
         jax.block_until_ready(self._paged_kv["k"])
 
     def _put(self, x, spec: P):
@@ -583,23 +620,98 @@ class InferenceEngine:
         shardings = tuple(self._named(s) for s in (rs, rs, rs, rs, rs, rs, rs2))
         return jax.device_put(arrs, shardings)
 
-    def _materialize(self, slab: "_Slab") -> None:
-        """Pull the device-resident slab state back into the host arrays so
-        host-side mutation (admission, retirement, failure) is safe; the
-        device copy is invalidated."""
+    def _dev_state(self, slab: "_Slab") -> tuple:
+        """The device-resident slab state tuple, initialising it from the
+        host arrays (startup / after a failure reset) when absent."""
         if slab.dev is None:
-            return
-        cur, pos, st, e, done, _budgets, _pt, buf = slab.dev
-        cur_h, pos_h, st_h, e_h, done_h, buf_h = jax.device_get(
-            (cur, pos, st, e, done, buf)
+            slab.dev = self._put_slab_state(slab) + (
+                self._put(slab.out_buf, self._row_spec(slab.B, 1)),
+            )
+        return slab.dev
+
+    def _merge_impl(
+        self,
+        cur,
+        pos,
+        st,
+        e,
+        done,
+        budgets,
+        pt,
+        buf,
+        rows,
+        cur_v,
+        pos_v,
+        st_v,
+        e_v,
+        done_v,
+        budgets_v,
+        pt_v,
+        buf_v,
+    ):
+        """Scatter per-row values into the slab's device state: row
+        ``rows[j]`` takes the j-th value of every value array. This is how
+        the host mutates rows WITHOUT a materialize round trip — admitted
+        rows get their post-prefill state, retired rows get done=True and a
+        zeroed page-table row (decode writes land on the reserved null
+        page). ``rows[j] == B`` entries are padding, dropped by the scatter
+        — one executable serves every merge size."""
+        return (
+            cur.at[rows].set(cur_v, mode="drop"),
+            pos.at[rows].set(pos_v, mode="drop"),
+            st.at[rows].set(st_v, mode="drop"),
+            e.at[rows].set(e_v, mode="drop"),
+            done.at[rows].set(done_v, mode="drop"),
+            budgets.at[rows].set(budgets_v, mode="drop"),
+            pt.at[rows].set(pt_v, mode="drop"),
+            buf.at[rows].set(buf_v, mode="drop"),
         )
-        slab.cur[:] = cur_h
-        slab.pos[:] = pos_h
-        slab.st[:] = st_h
-        slab.emitted[:] = e_h
-        slab.done[:] = done_h
-        slab.out_buf[:] = buf_h
-        slab.dev = None
+
+    def _dispatch_merge(self, slab: "_Slab", rows: list[int]) -> None:
+        """Dispatch one merge scatter for ``rows`` (+ any dirty retired
+        rows) into the device slab state. Async — no round trip."""
+        B = slab.B
+        dirty = [i for i in self._dirty_rows if i not in rows]
+        self._dirty_rows.clear()
+        n = len(rows) + len(dirty)
+        if n == 0:
+            return
+        idx = np.full((B,), B, np.int32)  # B = dropped padding
+        cur_v = np.full((B,), slab.pad_id, np.int32)
+        pos_v = np.zeros((B,), np.int32)
+        st_v = np.zeros((B,), np.int32)
+        e_v = np.zeros((B,), np.int32)
+        done_v = np.ones((B,), bool)
+        budgets_v = np.zeros((B,), np.int32)
+        pt_v = np.zeros((B, slab.page_table.shape[1]), np.int32)
+        buf_v = np.full((B, slab.steps), slab.pad_id, np.int32)
+        for j, i in enumerate(rows):
+            idx[j] = i
+            cur_v[j] = slab.cur[i]
+            pos_v[j] = slab.pos[i]
+            st_v[j] = slab.st[i]
+            e_v[j] = slab.emitted[i]
+            done_v[j] = slab.done[i]
+            budgets_v[j] = slab.budgets[i]
+            pt_v[j] = slab.page_table[i]
+            buf_v[j] = slab.out_buf[i]
+        for j, i in enumerate(dirty, start=len(rows)):
+            idx[j] = i  # retired row: defaults above are exactly the clear
+        rs = self._row_spec(B)
+        rs2 = self._row_spec(B, 1)
+        state = self._dev_state(slab)
+        slab.dev = self._jit_merge(
+            *state,
+            self._put(idx, rs),
+            self._put(cur_v, rs),
+            self._put(pos_v, rs),
+            self._put(st_v, rs),
+            self._put(e_v, rs),
+            self._put(done_v, rs),
+            self._put(budgets_v, rs),
+            self._put(pt_v, rs2),
+            self._put(buf_v, rs2),
+        )
 
     def prompt_capacity(self, max_new_tokens: int = 0, shared_prefix_len: int = 0) -> int:
         """Longest prompt (in tokens) the engine can serve alongside a
@@ -1034,7 +1146,10 @@ class InferenceEngine:
         slab = self._slab
         pending: "deque[GenerateRequest]" = deque()
         while True:
-            self._drain_queue(pending, block=(not pending and slab.n_active == 0))
+            self._drain_queue(
+                pending,
+                block=(not pending and slab.n_active == 0 and not self._inflight),
+            )
             if self._stop:
                 break
             if pending and slab.n_active < slab.B:
@@ -1046,12 +1161,35 @@ class InferenceEngine:
                     self._reset_pools()
             if slab.n_active:
                 try:
-                    self._run_segment(slab)
+                    # Dispatch first, THEN fetch a lagged segment's flags:
+                    # the fetch's round trip rides on top of the segment the
+                    # device is already computing.
+                    self._dispatch_segment(slab)
+                    self._harvest(
+                        slab,
+                        keep_inflight=max(0, self.config.engine.pipeline_depth - 1),
+                    )
                 except BaseException as e:  # noqa: BLE001 - keep worker alive
                     log.exception("decode segment failed; failing resident rows")
                     self._fail_rows(slab, e)
                     self._reset_pools()
-        # Shutdown: nothing resident, pending, or enqueued may be left hanging.
+            elif self._inflight:
+                # Nothing active by the host's (lagged) view but segments
+                # still in flight: drain them so idle blocking is safe.
+                try:
+                    self._harvest(slab, keep_inflight=0)
+                except BaseException as e:  # noqa: BLE001 - keep worker alive
+                    log.exception("segment harvest failed; failing resident rows")
+                    self._fail_rows(slab, e)
+                    self._reset_pools()
+        # Shutdown: harvest what the device already finished — a request one
+        # lagged flag-fetch away from delivery must resolve, not be failed —
+        # then nothing resident, pending, or enqueued may be left hanging.
+        if self._inflight:
+            try:
+                self._harvest(slab, keep_inflight=0)
+            except BaseException:  # noqa: BLE001 - closing anyway
+                log.exception("final harvest failed during shutdown")
         closed = EngineError("engine closed")
         self._fail_rows(slab, closed)
         for r in pending:
@@ -1140,6 +1278,13 @@ class InferenceEngine:
         head_req = next((r for r in pending if slab.compatible(r)), None)
         if head_req is None:
             return
+        # Retired rows' DEVICE page tables must be zeroed BEFORE any pages
+        # are (re)allocated below (prefix build or cohort prefill writes
+        # into freed pages; a dirty row's in-flight garbage writes must be
+        # pointed at the null page first). Async dispatch, device-ordered
+        # ahead of the prefills.
+        if self._dirty_rows:
+            self._dispatch_merge(slab, [])
         prefix: Optional[_Prefix] = None
         head_key = (
             head_req.prefix_key(ecfg.kv_page_size) if ecfg.prefix_cache else None
@@ -1300,11 +1445,6 @@ class InferenceEngine:
                 )
             )
             t1 = time.monotonic()
-            # Inside the try: _materialize device_gets resident state, and a
-            # tunnel/device failure here must fail THIS cohort's futures and
-            # free its pages (the cohort is not yet merged into slab rows, so
-            # the worker-level handler cannot see it).
-            self._materialize(slab)
         except BaseException as e:  # noqa: BLE001 - fail cohort AND residents
             # Prefill DONATES the pools: after a runtime failure the resident
             # rows' KV may live in already-deleted buffers, so they cannot
@@ -1323,6 +1463,7 @@ class InferenceEngine:
         self.metrics.prefill_tokens.inc(int(seq_lens[: len(cohort)].sum()))
         self.metrics.admissions.inc()
         self.metrics.admitted_rows.inc(len(cohort))
+        merged_rows: list[int] = []
         for j, r in enumerate(cohort):
             if done0[j]:
                 # EOS-first or zero budget: complete at admission.
@@ -1343,6 +1484,11 @@ class InferenceEngine:
                 continue
             i = free.pop(0)
             slab.req[i] = r
+            # Bump the row generation NOW: a still-in-flight segment from
+            # before this admission reports the then-free row done=True, and
+            # without the bump its (lagged) harvest would retire this fresh
+            # request with zero tokens.
+            slab.gen[i] += 1
             slab.sid[i] = sids[j]
             slab.cur[i] = cur0[j]
             slab.pos[i] = P + seq_lens[j]
@@ -1356,13 +1502,20 @@ class InferenceEngine:
             slab.queue_ms[i] = (t0 - r.enqueued_at) * 1e3
             slab.prefill_ms[i] = prefill_ms
             slab.t_decode0[i] = t1
+            merged_rows.append(i)
             if prefix is not None:
                 prefix.refs += 1
                 slab.prefix[i] = prefix
+        # Admitted rows (and any dirty retired rows) enter the DEVICE slab
+        # state via one async merge scatter — no materialize round trip.
+        self._dispatch_merge(slab, merged_rows)
         self.metrics.kv_page_utilization.set(self._allocator.stats().utilization)
         self.metrics.batch_occupancy.set(slab.n_active)
 
-    def _run_segment(self, slab: "_Slab") -> None:
+    def _dispatch_segment(self, slab: "_Slab") -> None:
+        """Dispatch one decode segment chained on the device slab state and
+        push its output handles onto the in-flight deque. Async: returns as
+        soon as XLA has the work enqueued (~ms), while the device computes."""
         ecfg = self.config.engine
         chunk = self._spec_chunk(slab.constrained)
         iters = max(1, ecfg.decode_steps_per_tick)
@@ -1370,13 +1523,9 @@ class InferenceEngine:
         self.metrics.segment_active_rows.inc(slab.n_active)
         dfa = self._dfa_for(slab.grammar or self.grammar)
         self._seg_counter += 1
-        if slab.dev is None:
-            state = self._put_slab_state(slab) + (
-                self._put(slab.out_buf, self._row_spec(slab.B, 1)),
-            )
-        else:
-            state = slab.dev
-        cur_d, pos_d, st_d, e_d, done_d, budgets_d, pt_d, buf_in = state
+        cur_d, pos_d, st_d, e_d, done_d, budgets_d, pt_d, buf_in = self._dev_state(
+            slab
+        )
         out = self._jit_segment(
             self._params,
             *dfa,
@@ -1399,40 +1548,53 @@ class InferenceEngine:
         cur_d, pos_d, st_d, e_d, done_d, k_p, v_p, buf_d, n_fwd = out
         self._paged_kv = {"k": k_p, "v": v_p}
         slab.dev = (cur_d, pos_d, st_d, e_d, done_d, budgets_d, pt_d, buf_d)
-        # Small fetch only: full state comes back to the host lazily, on
-        # mutation ticks (_materialize) — not every segment.
-        done, e, n_fwd = jax.device_get((done_d, e_d, n_fwd))
-        t1 = time.monotonic()
-        slab.done[:] = done
-        slab.emitted[:] = e
-        self.metrics.decode_forwards.inc(int(n_fwd))
+        self._inflight.append(
+            (done_d, e_d, buf_d, n_fwd, slab.gen.copy(), time.monotonic())
+        )
 
-        if not any(slab.req[i] is not None and done[i] for i in range(slab.B)):
-            return
-        self._materialize(slab)
-        for i in range(slab.B):
-            r = slab.req[i]
-            if r is None or not slab.done[i]:
-                continue
-            ids = [int(t) for t in slab.out_buf[i, : slab.emitted[i]]]
-            res = GenerateResult(
-                token_ids=ids,
-                text=self.tokenizer.decode(ids),
-                prompt_tokens=len(r.prompt_ids),
-                generated_tokens=len(ids),
-                queue_ms=slab.queue_ms[i],
-                prefill_ms=slab.prefill_ms[i],
-                decode_ms=(t1 - slab.t_decode0[i]) * 1e3,
-            )
-            self.metrics.decode_tokens.inc(len(ids))
-            self.metrics.engine_queue_seconds.observe(res.queue_ms / 1e3)
-            self.metrics.engine_prefill_seconds.observe(res.prefill_ms / 1e3)
-            self.metrics.engine_decode_seconds.observe(res.decode_ms / 1e3)
-            self._allocator.free(slab.sid[i])
-            slab.clear_row(i)
-            r.loop.call_soon_threadsafe(_resolve, r.future, res, None)
-        self.metrics.kv_page_utilization.set(self._allocator.stats().utilization)
-        self.metrics.batch_occupancy.set(slab.n_active)
+    def _harvest(self, slab: "_Slab", keep_inflight: int) -> None:
+        """Fetch flags + out_buf of in-flight segments (oldest first) until
+        at most ``keep_inflight`` remain, retiring rows whose requests
+        finished. With pipeline_depth D the fetch lags dispatch by D-1
+        segments, so its round trip overlaps device compute; done rows stop
+        emitting (sticky ``done`` in the segment body), so a lagged out_buf
+        is final for any row it reports done. The generation snapshot guards
+        against a done-flag from before a row was re-admitted retiring the
+        row's NEW request."""
+        while len(self._inflight) > keep_inflight:
+            done_d, e_d, buf_d, nfwd_d, gen_snap, _t = self._inflight.popleft()
+            done, e, buf, n_fwd = jax.device_get((done_d, e_d, buf_d, nfwd_d))
+            t1 = time.monotonic()
+            self.metrics.decode_forwards.inc(int(n_fwd))
+            retired = False
+            for i in range(slab.B):
+                r = slab.req[i]
+                if r is None or not done[i] or gen_snap[i] != slab.gen[i]:
+                    continue
+                ids = [int(t) for t in buf[i, : e[i]]]
+                res = GenerateResult(
+                    token_ids=ids,
+                    text=self.tokenizer.decode(ids),
+                    prompt_tokens=len(r.prompt_ids),
+                    generated_tokens=len(ids),
+                    queue_ms=slab.queue_ms[i],
+                    prefill_ms=slab.prefill_ms[i],
+                    decode_ms=(t1 - slab.t_decode0[i]) * 1e3,
+                )
+                self.metrics.decode_tokens.inc(len(ids))
+                self.metrics.engine_queue_seconds.observe(res.queue_ms / 1e3)
+                self.metrics.engine_prefill_seconds.observe(res.prefill_ms / 1e3)
+                self.metrics.engine_decode_seconds.observe(res.decode_ms / 1e3)
+                self._allocator.free(slab.sid[i])
+                slab.clear_row(i)
+                self._dirty_rows.add(i)
+                retired = True
+                r.loop.call_soon_threadsafe(_resolve, r.future, res, None)
+            if retired:
+                self.metrics.kv_page_utilization.set(
+                    self._allocator.stats().utilization
+                )
+                self.metrics.batch_occupancy.set(slab.n_active)
 
     def _init_pools(self) -> dict:
         """Fresh zeroed KV page pools, sharded over the mesh: KV heads on
@@ -1473,8 +1635,12 @@ class InferenceEngine:
 
     def _fail_rows(self, slab: "_Slab", error: BaseException) -> None:
         # Device copies may be stale or deleted (donated into a failed
-        # call); host state is authoritative from here.
+        # call); host state is authoritative from here. In-flight segment
+        # handles chain from the same failed dispatch — drop them (their
+        # rows are failed right here, nothing left to harvest).
         slab.dev = None
+        self._inflight.clear()
+        self._dirty_rows.clear()
         for i in range(slab.B):
             r = slab.req[i]
             if r is None:
