@@ -5,7 +5,8 @@ the tool for attributing serving throughput between the engine proper and
 the control-plane layers above it.
 
 Env knobs: PROBE_MODEL (2b|test), PROBE_REQUESTS, PROBE_BATCH, PROBE_TICK,
-PROBE_SPEC, PROBE_KEYS (1 = trie the "in" keys), PROBE_CPU=N (arm an
+PROBE_SPEC, PROBE_DEPTH (worker pipeline depth), PROBE_KEYS (1 = trie the
+"in" keys), PROBE_CPU=N (arm an
 N-device virtual CPU platform — env vars alone cannot evict the latched TPU
 backend, and the tunnel blocks a second client in make_c_api_client).
 
@@ -49,7 +50,7 @@ def _snap(eng):
 
 
 async def run_one(*, model: str, n_req: int, batch: int, tick: int, spec: int,
-                  with_keys: bool) -> dict:
+                  with_keys: bool, depth: int) -> dict:
     from mcpx.core.config import MCPXConfig
     from mcpx.engine.engine import InferenceEngine
     from mcpx.planner.grammar import build_plan_grammar
@@ -69,6 +70,7 @@ async def run_one(*, model: str, n_req: int, batch: int, tick: int, spec: int,
                 "warmup_compile": False,
                 "decode_steps_per_tick": tick,
                 "speculate_k": spec,
+                "pipeline_depth": depth,
             },
         }
     )
@@ -107,7 +109,7 @@ async def run_one(*, model: str, n_req: int, batch: int, tick: int, spec: int,
     gen = sum(r.generated_tokens for r in results)
     out = {
         "model": model, "batch": batch, "tick": tick, "spec": spec,
-        "keys": int(with_keys), "requests": n_req,
+        "depth": depth, "keys": int(with_keys), "requests": n_req,
         "plans_per_sec": round(n_req / dt, 2),
         "elapsed_s": round(dt, 2),
         "startup_s": round(t_start, 1),
@@ -136,6 +138,7 @@ def _base() -> dict:
         "tick": int(os.environ.get("PROBE_TICK", "2")),
         "spec": int(os.environ.get("PROBE_SPEC", "8")),
         "with_keys": os.environ.get("PROBE_KEYS", "1") == "1",
+        "depth": int(os.environ.get("PROBE_DEPTH", "2")),
     }
 
 
@@ -152,7 +155,7 @@ async def main() -> None:
                     c["with_keys"] = v == "1"
                 elif k == "requests":
                     c["n_req"] = int(v)
-                elif k in ("tick", "spec", "batch"):
+                elif k in ("tick", "spec", "batch", "depth"):
                     c[k] = int(v)
                 elif k == "model":
                     c["model"] = v

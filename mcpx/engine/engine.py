@@ -1285,6 +1285,12 @@ class InferenceEngine:
         # ahead of the prefills.
         if self._dirty_rows:
             self._dispatch_merge(slab, [])
+        # Admission blocks the host on prefill+admit+round-trip; give the
+        # device a decode segment over the RESIDENT rows first so they
+        # progress (and the chip stays busy) underneath that stall. The
+        # worker's harvest bound drains the extra in-flight entry next tick.
+        if slab.n_active:
+            self._dispatch_segment(slab)
         prefix: Optional[_Prefix] = None
         head_key = (
             head_req.prefix_key(ecfg.kv_page_size) if ecfg.prefix_cache else None
@@ -1548,9 +1554,7 @@ class InferenceEngine:
         cur_d, pos_d, st_d, e_d, done_d, k_p, v_p, buf_d, n_fwd = out
         self._paged_kv = {"k": k_p, "v": v_p}
         slab.dev = (cur_d, pos_d, st_d, e_d, done_d, budgets_d, pt_d, buf_d)
-        self._inflight.append(
-            (done_d, e_d, buf_d, n_fwd, slab.gen.copy(), time.monotonic())
-        )
+        self._inflight.append((done_d, e_d, buf_d, n_fwd, slab.gen.copy()))
 
     def _harvest(self, slab: "_Slab", keep_inflight: int) -> None:
         """Fetch flags + out_buf of in-flight segments (oldest first) until
@@ -1562,8 +1566,15 @@ class InferenceEngine:
         against a done-flag from before a row was re-admitted retiring the
         row's NEW request."""
         while len(self._inflight) > keep_inflight:
-            done_d, e_d, buf_d, nfwd_d, gen_snap, _t = self._inflight.popleft()
+            done_d, e_d, buf_d, nfwd_d, gen_snap = self._inflight.popleft()
+            # ONE combined fetch (flags + out_buf): the tunnel's cost is the
+            # round trip (~72ms), not the ~24KB of buffer — splitting into
+            # flags-then-buf would add a second round trip on every
+            # retirement tick, which at steady state is most ticks.
             done, e, buf, n_fwd = jax.device_get((done_d, e_d, buf_d, nfwd_d))
+            # decode_ms below is time-to-delivery: it includes the
+            # pipeline's depth-1 segment lag, because that lag is part of
+            # what the caller actually waits for.
             t1 = time.monotonic()
             self.metrics.decode_forwards.inc(int(n_fwd))
             retired = False
