@@ -50,14 +50,14 @@ def _snap(eng):
 
 
 async def run_one(*, model: str, n_req: int, batch: int, tick: int, spec: int,
-                  with_keys: bool, depth: int) -> dict:
+                  with_keys: bool, depth: int, vocab: str) -> dict:
     from mcpx.core.config import MCPXConfig
     from mcpx.engine.engine import InferenceEngine
     from mcpx.planner.grammar import build_plan_grammar
 
     cfg = MCPXConfig.from_dict(
         {
-            "model": {"size": model, "max_seq_len": 2048},
+            "model": {"size": model, "max_seq_len": 2048, "vocab": vocab},
             "engine": {
                 "max_batch_size": batch,
                 "max_decode_len": 96,
@@ -109,7 +109,7 @@ async def run_one(*, model: str, n_req: int, batch: int, tick: int, spec: int,
     gen = sum(r.generated_tokens for r in results)
     out = {
         "model": model, "batch": batch, "tick": tick, "spec": spec,
-        "depth": depth, "keys": int(with_keys), "requests": n_req,
+        "depth": depth, "vocab": vocab, "keys": int(with_keys), "requests": n_req,
         "plans_per_sec": round(n_req / dt, 2),
         "elapsed_s": round(dt, 2),
         "startup_s": round(t_start, 1),
@@ -139,6 +139,7 @@ def _base() -> dict:
         "spec": int(os.environ.get("PROBE_SPEC", "8")),
         "with_keys": os.environ.get("PROBE_KEYS", "1") == "1",
         "depth": int(os.environ.get("PROBE_DEPTH", "2")),
+        "vocab": os.environ.get("PROBE_VOCAB", "bpe"),
     }
 
 
@@ -159,6 +160,8 @@ async def main() -> None:
                     c[k] = int(v)
                 elif k == "model":
                     c["model"] = v
+                elif k == "vocab":
+                    c["vocab"] = v
                 else:
                     raise SystemExit(f"unknown sweep key {k!r}")
             configs.append(c)

@@ -251,6 +251,12 @@ class InferenceEngine:
         # reused, because reuse requires an admission and every admission
         # dispatches a merge.
         self._dirty_rows: set[int] = set()
+        # Admission chains whose completion hasn't been observed yet:
+        # (dispatch-end time, marker handle, row indices, gen snapshot).
+        # Resolved by non-blocking is_ready() polls — admission never
+        # blocks the host (async admission), so prefill timing comes from
+        # the poll that first sees the chain finished (≤1 tick late).
+        self._pending_admissions: list[tuple] = []
         self._seg_counter = 0
         self._seq_counter = 0
         self._last_admit_t = 0.0
@@ -348,7 +354,9 @@ class InferenceEngine:
             self._jit_segment = None
             self._jit_suffix_prefill = None
             self._jit_merge = None
+            self._jit_admit_merge = None
             self._inflight.clear()
+            self._pending_admissions.clear()
             self._dfa_cache.clear()
             self._prefix_cache.clear()
         else:
@@ -479,9 +487,11 @@ class InferenceEngine:
             static_argnames=("iters", "chunk", "temperature", "constrained"),
             donate_argnames=("paged_k", "paged_v"),
         )
-        # Merge donates NOTHING: its inputs are the newest segment's output
-        # handles, which the newest in-flight entry still needs readable.
+        # Merges donate NOTHING: their inputs are the newest segment's
+        # output handles, which the newest in-flight entry still needs
+        # readable.
         self._jit_merge = jax.jit(self._merge_impl)
+        self._jit_admit_merge = jax.jit(self._admit_merge_impl)
         self._slab = _Slab(
             ecfg.max_batch_size,
             ecfg.max_decode_len,
@@ -567,7 +577,7 @@ class InferenceEngine:
                         self._paged_kv["v"],
                     )
                     self._paged_kv = {"k": k_p, "v": v_p}
-            self._jit_admit(
+            admit_out = self._jit_admit(
                 *dfa,
                 last,
                 self._put(np.zeros((A,), np.int32), self._row_spec(A)),
@@ -575,6 +585,20 @@ class InferenceEngine:
                 key,
                 temperature=ecfg.temperature,
                 constrained=True,
+            )
+            # Admit-merge executable for this cohort bucket (all-dropped
+            # scatter: rows filled with B = padding, a semantic no-op).
+            rs_a = self._row_spec(A)
+            self._jit_admit_merge(
+                *self._dev_state(self._slab),
+                self._put(np.full((A,), self._slab.B, np.int32), rs_a),
+                *admit_out,
+                self._put(np.zeros((A,), np.int32), rs_a),
+                self._put(np.zeros((A,), np.int32), rs_a),
+                self._put(
+                    np.zeros((A, ecfg.max_pages_per_seq), np.int32),
+                    self._row_spec(A, 1),
+                ),
             )
         slab = self._slab
         chunk = self._spec_chunk(True)
@@ -666,6 +690,69 @@ class InferenceEngine:
             pt.at[rows].set(pt_v, mode="drop"),
             buf.at[rows].set(buf_v, mode="drop"),
         )
+
+    def _admit_merge_impl(
+        self,
+        cur,
+        pos,
+        st,
+        e,
+        done,
+        budgets,
+        pt,
+        buf,
+        rows,
+        cur0,
+        st0,
+        done0,
+        pos_v,
+        budgets_v,
+        pt_v,
+    ):
+        """Scatter a freshly-prefilled admission cohort into the device slab
+        state with ZERO host fetches: ``cur0``/``st0``/``done0`` are
+        ``_admit_impl``'s output handles, chained device-to-device. Rows
+        whose first sample was already EOS (``done0``) enter with emitted=0
+        and retire empty at their first harvest. ``rows[j] == B`` entries
+        (bucket padding / inactive lanes) are dropped by the scatter."""
+        pad = self.tokenizer.pad_id
+        W = buf.shape[1]
+        A = rows.shape[0]
+        e0 = jnp.where(done0, 0, 1).astype(jnp.int32)
+        buf = buf.at[rows].set(
+            jnp.full((A, W), pad, jnp.int32), mode="drop"
+        )
+        buf = buf.at[rows, 0].set(cur0, mode="drop")
+        return (
+            cur.at[rows].set(cur0, mode="drop"),
+            pos.at[rows].set(pos_v, mode="drop"),
+            st.at[rows].set(st0, mode="drop"),
+            e.at[rows].set(e0, mode="drop"),
+            done.at[rows].set(done0, mode="drop"),
+            budgets.at[rows].set(budgets_v, mode="drop"),
+            pt.at[rows].set(pt_v, mode="drop"),
+            buf,
+        )
+
+    def _poll_admissions(self, slab: "_Slab") -> None:
+        """Resolve pending admission chains whose device work has finished
+        (non-blocking ``is_ready`` checks, FIFO — device order means a
+        not-ready head implies a not-ready tail). Sets the cohort's
+        prefill time and the start-of-decode timestamp; both are observed
+        at most one tick late, which is noise next to the blocking fetch
+        this replaces."""
+        now = time.monotonic()
+        while self._pending_admissions:
+            t0, marker, rows, gens = self._pending_admissions[0]
+            if not marker.is_ready():
+                return
+            self._pending_admissions.pop(0)
+            dt = (now - t0) * 1e3
+            for i, g in zip(rows, gens):
+                if slab.req[i] is None or slab.gen[i] != g:
+                    continue
+                slab.prefill_ms[i] = dt
+                slab.t_decode0[i] = now
 
     def _dispatch_merge(self, slab: "_Slab", rows: list[int]) -> None:
         """Dispatch one merge scatter for ``rows`` (+ any dirty retired
@@ -854,7 +941,10 @@ class InferenceEngine:
         cfg = self.model_cfg
         B = tokens.shape[0]
         dense = init_kv_cache(cfg, B, T)
-        logits, dense = prefill(params, cfg, tokens, seq_lens, dense)
+        # last_only: the [B, T, V] logits buffer must never exist — at
+        # subword vocab sizes it is hundreds of MB per cohort and its
+        # unembed matmul rivals the whole layer stack.
+        last, dense = prefill(params, cfg, tokens, seq_lens, dense, last_only=True)
         paged = commit_prefill_to_pages(
             {"k": paged_k, "v": paged_v},
             dense,
@@ -862,7 +952,6 @@ class InferenceEngine:
             seq_lens,
             self.config.engine.kv_page_size,
         )
-        last = logits[jnp.arange(B), seq_lens - 1]  # [B, V]
         return last, paged["k"], paged["v"]
 
     def _suffix_prefill_impl(
@@ -878,8 +967,7 @@ class InferenceEngine:
         speculation-width chunks, and prefill-width attention is a small
         fraction of the suffix matmuls anyway."""
         cfg = self.model_cfg
-        A = tokens.shape[0]
-        logits_all, kv = decode_chunk_paged(
+        last, kv = decode_chunk_paged(
             params,
             cfg,
             tokens,
@@ -888,8 +976,8 @@ class InferenceEngine:
             {"k": paged_k, "v": paged_v},
             use_pallas=False,
             interpret=self.config.engine.interpret,
+            logits_at=seq_lens - 1,  # [A, V]: suffix-final logits only
         )
-        last = logits_all[jnp.arange(A), seq_lens - 1]  # [A, V]
         return last, kv["k"], kv["v"]
 
     def _ensure_prefix(self, key: tuple) -> Optional["_Prefix"]:
@@ -1068,7 +1156,8 @@ class InferenceEngine:
             # a row's chain write garbage K/V that the next chunk overwrites
             # (decode_chunk_paged contract); done/free rows write to the
             # null page via their zeroed page-table rows.
-            logits_all, kv = decode_chunk_paged(
+            adv = jnp.where(done, 0, 1) + adv_extra  # tokens consumed
+            last_logits, kv = decode_chunk_paged(
                 params,
                 cfg,
                 chunk_toks,
@@ -1077,9 +1166,8 @@ class InferenceEngine:
                 {"k": k_p, "v": v_p},
                 use_pallas=self._use_pallas,
                 interpret=self.config.engine.interpret,
+                logits_at=jnp.maximum(adv - 1, 0),  # [B, V]: chain-end only
             )
-            adv = jnp.where(done, 0, 1) + adv_extra  # tokens consumed
-            last_logits = logits_all[b_idx, jnp.maximum(adv - 1, 0)]  # [B, V]
 
             key, sub = jax.random.split(key)
             if constrained:
@@ -1152,6 +1240,7 @@ class InferenceEngine:
             )
             if self._stop:
                 break
+            self._poll_admissions(slab)
             if pending and slab.n_active < slab.B:
                 try:
                     self._admit(slab, pending)
@@ -1285,12 +1374,6 @@ class InferenceEngine:
         # ahead of the prefills.
         if self._dirty_rows:
             self._dispatch_merge(slab, [])
-        # Admission blocks the host on prefill+admit+round-trip; give the
-        # device a decode segment over the RESIDENT rows first so they
-        # progress (and the chip stays busy) underneath that stall. The
-        # worker's harvest bound drains the extra in-flight entry next tick.
-        if slab.n_active:
-            self._dispatch_segment(slab)
         prefix: Optional[_Prefix] = None
         head_key = (
             head_req.prefix_key(ecfg.kv_page_size) if ecfg.prefix_cache else None
@@ -1439,23 +1522,26 @@ class InferenceEngine:
             # immediately so an exception below can't leave stale handles.
             self._paged_kv = {"k": k_p, "v": v_p}
             self._seg_counter += 1
-            cur0, st0, done0 = jax.device_get(
-                self._jit_admit(
-                    *dfa,
-                    last_logits,
-                    self._put(budgets_np, self._row_spec(A)),
-                    self._put(active, self._row_spec(A)),
-                    jax.random.PRNGKey((self._rng_base + self._seg_counter) & 0x7FFFFFFF),
-                    temperature=slab.temperature,
-                    constrained=slab.constrained,
-                )
+            # Device handles only — ASYNC ADMISSION: the host never waits
+            # for prefill/first-sample. (The old blocking fetch here cost a
+            # full device-queue drain + round trip per cohort, the largest
+            # single stall in the serving loop once segments pipelined.)
+            cur0, st0, done0 = self._jit_admit(
+                *dfa,
+                last_logits,
+                self._put(budgets_np, self._row_spec(A)),
+                self._put(active, self._row_spec(A)),
+                jax.random.PRNGKey((self._rng_base + self._seg_counter) & 0x7FFFFFFF),
+                temperature=slab.temperature,
+                constrained=slab.constrained,
             )
-            t1 = time.monotonic()
         except BaseException as e:  # noqa: BLE001 - fail cohort AND residents
-            # Prefill DONATES the pools: after a runtime failure the resident
-            # rows' KV may live in already-deleted buffers, so they cannot
-            # continue either — fail everything and restore fresh pools
-            # rather than letting the next segment crash on stale handles.
+            # Prefill DONATES the pools: after a dispatch failure the
+            # resident rows' KV may live in already-deleted buffers, so they
+            # cannot continue either — fail everything and restore fresh
+            # pools rather than letting the next segment crash on stale
+            # handles. (Runtime failures now surface at the next harvest
+            # fetch instead, where the worker-level handler does the same.)
             for sid in sids:
                 self._allocator.free(sid)
             for r in cohort:
@@ -1464,31 +1550,15 @@ class InferenceEngine:
             self._reset_pools()
             return
 
-        prefill_ms = (t1 - t0) * 1e3
+        t1 = time.monotonic()
         self._last_admit_t = t1
         self.metrics.prefill_tokens.inc(int(seq_lens[: len(cohort)].sum()))
         self.metrics.admissions.inc()
         self.metrics.admitted_rows.inc(len(cohort))
-        merged_rows: list[int] = []
+        rows_idx: list[int] = []
         for j, r in enumerate(cohort):
-            if done0[j]:
-                # EOS-first or zero budget: complete at admission.
-                self._allocator.free(sids[j])
-                res = GenerateResult(
-                    token_ids=[],
-                    text="",
-                    prompt_tokens=len(r.prompt_ids),
-                    generated_tokens=0,
-                    queue_ms=(t0 - r.enqueued_at) * 1e3,
-                    prefill_ms=prefill_ms,
-                    decode_ms=0.0,
-                )
-                self.metrics.engine_queue_seconds.observe(res.queue_ms / 1e3)
-                self.metrics.engine_prefill_seconds.observe(res.prefill_ms / 1e3)
-                self.metrics.engine_decode_seconds.observe(0.0)
-                r.loop.call_soon_threadsafe(_resolve, r.future, res, None)
-                continue
             i = free.pop(0)
+            rows_idx.append(i)
             slab.req[i] = r
             # Bump the row generation NOW: a still-in-flight segment from
             # before this admission reports the then-free row done=True, and
@@ -1496,25 +1566,46 @@ class InferenceEngine:
             # request with zero tokens.
             slab.gen[i] += 1
             slab.sid[i] = sids[j]
-            slab.cur[i] = cur0[j]
-            slab.pos[i] = P + seq_lens[j]
-            slab.st[i] = st0[j]
+            # cur/st host mirrors stay at clear values: the authoritative
+            # first-token state lives only on device (admit outputs chained
+            # into the admit-merge). EOS-at-first-sample rows retire empty
+            # at their first harvest (emitted=0 via the merge).
+            slab.pos[i] = P + int(seq_lens[j])
             slab.emitted[i] = 1
             slab.done[i] = False
             slab.budgets[i] = budgets_np[j]
             slab.out_buf[i, :] = tok.pad_id
-            slab.out_buf[i, 0] = cur0[j]
             slab.page_table[i, :] = table[j]
             slab.queue_ms[i] = (t0 - r.enqueued_at) * 1e3
-            slab.prefill_ms[i] = prefill_ms
+            slab.prefill_ms[i] = -1.0  # resolved by _poll_admissions
             slab.t_decode0[i] = t1
-            merged_rows.append(i)
             if prefix is not None:
                 prefix.refs += 1
                 slab.prefix[i] = prefix
-        # Admitted rows (and any dirty retired rows) enter the DEVICE slab
-        # state via one async merge scatter — no materialize round trip.
-        self._dispatch_merge(slab, merged_rows)
+        rows_arr = np.full((A,), slab.B, np.int32)  # B = dropped padding
+        rows_arr[: len(rows_idx)] = rows_idx
+        pos_arr = np.zeros((A,), np.int32)
+        pos_arr[: len(cohort)] = P + seq_lens[: len(cohort)]
+        rs = self._row_spec(A)
+        try:
+            state = self._dev_state(slab)
+            slab.dev = self._jit_admit_merge(
+                *state,
+                self._put(rows_arr, rs),
+                cur0,
+                st0,
+                done0,
+                self._put(pos_arr, rs),
+                self._put(budgets_np, rs),
+                self._put(table, self._row_spec(A, 1)),
+            )
+        except BaseException as e:  # noqa: BLE001 - rows already assigned
+            self._fail_rows(slab, e)
+            self._reset_pools()
+            return
+        self._pending_admissions.append(
+            (t1, slab.dev[4], rows_idx, [int(slab.gen[i]) for i in rows_idx])
+        )
         self.metrics.kv_page_utilization.set(self._allocator.stats().utilization)
         self.metrics.batch_occupancy.set(slab.n_active)
 
@@ -1572,6 +1663,10 @@ class InferenceEngine:
             # flags-then-buf would add a second round trip on every
             # retirement tick, which at steady state is most ticks.
             done, e, buf, n_fwd = jax.device_get((done_d, e_d, buf_d, nfwd_d))
+            # The blocking fetch above implies every earlier admission chain
+            # has executed — resolve their timings before retiring rows that
+            # may have finished in their very first segment.
+            self._poll_admissions(slab)
             # decode_ms below is time-to-delivery: it includes the
             # pipeline's depth-1 segment lag, because that lag is part of
             # what the caller actually waits for.
@@ -1589,7 +1684,7 @@ class InferenceEngine:
                     prompt_tokens=len(r.prompt_ids),
                     generated_tokens=len(ids),
                     queue_ms=slab.queue_ms[i],
-                    prefill_ms=slab.prefill_ms[i],
+                    prefill_ms=max(0.0, slab.prefill_ms[i]),
                     decode_ms=(t1 - slab.t_decode0[i]) * 1e3,
                 )
                 self.metrics.decode_tokens.inc(len(ids))
@@ -1652,6 +1747,7 @@ class InferenceEngine:
         slab.dev = None
         self._inflight.clear()
         self._dirty_rows.clear()
+        self._pending_admissions.clear()
         for i in range(slab.B):
             r = slab.req[i]
             if r is None:

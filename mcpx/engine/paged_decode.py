@@ -35,6 +35,7 @@ def decode_chunk_paged(
     *,
     use_pallas: bool = True,
     interpret: bool = False,
+    logits_at: "jax.Array | None" = None,  # [B] chunk slot per row, or None
 ) -> tuple[jax.Array, dict[str, jax.Array]]:
     """Multi-token decode step: S new tokens per sequence in ONE forward.
 
@@ -51,7 +52,8 @@ def decode_chunk_paged(
     Tokens past a sequence's valid chain are pads; their K/V slots hold
     garbage that the next chunk (which starts at the first invalid
     position) overwrites, and their logits are ignored by the caller.
-    Returns ([B, S, V] logits, pools).
+    Returns ([B, S, V] logits, pools) — or ([B, V], pools) when
+    ``logits_at`` names the single chunk slot per row to unembed.
     """
     B, S = tokens.shape
     K, L, N, psz, hd = paged_kv["k"].shape
@@ -115,6 +117,17 @@ def decode_chunk_paged(
         params["layers"],
     )
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    if logits_at is not None:
+        # Serving only reads ONE position's logits per row (the last valid
+        # chunk slot): gather the hidden state BEFORE the unembed so the
+        # [B, S, V] logits buffer never exists and the unembed matmul costs
+        # 1/S of the all-positions version — at subword vocab sizes that
+        # buffer and those FLOPs rival a whole transformer layer.
+        x1 = x[jnp.arange(B), logits_at]  # [B, D]
+        logits1 = jnp.einsum(
+            "bd,vd->bv", x1, params["embed"], preferred_element_type=jnp.float32
+        )
+        return logits1, {"k": k_new, "v": v_new}
     logits = jnp.einsum("bsd,vd->bsv", x, params["embed"], preferred_element_type=jnp.float32)
     return logits, {"k": k_new, "v": v_new}
 

@@ -166,11 +166,13 @@ def forward(
     kv_cache: KVCache,
     mask: jax.Array,
     attend_fn=None,
+    logits_at: "jax.Array | None" = None,
 ) -> tuple[jax.Array, KVCache]:
     """Core forward over a [B, T] token chunk against a [L, B, S, K, hd]
     cache. ``positions`` are absolute (double as cache write slots);
     ``mask`` is [B, T, S] (True = attend). ``attend_fn`` swaps the attention
-    op (e.g. ring attention for sequence-parallel long-context prefill)."""
+    op (e.g. ring attention for sequence-parallel long-context prefill).
+    ``logits_at`` [B]: unembed only that position per row -> [B, V]."""
     x = params["embed"][tokens].astype(jnp.dtype(cfg.dtype))
     x = x * jnp.asarray(math.sqrt(cfg.d_model), x.dtype)
 
@@ -184,6 +186,18 @@ def forward(
         body, x, (params["layers"], kv_cache["k"], kv_cache["v"])
     )
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    if logits_at is not None:
+        # Single-position unembed (serving prefill reads only each row's
+        # last prompt token): gathering the hidden state first keeps the
+        # [B, T, V] logits buffer from ever existing — at subword vocab
+        # sizes that buffer is hundreds of MB and its matmul rivals the
+        # whole layer stack.
+        B = tokens.shape[0]
+        x1 = x[jnp.arange(B), logits_at]  # [B, D]
+        logits1 = jnp.einsum(
+            "bd,vd->bv", x1, params["embed"], preferred_element_type=jnp.float32
+        )
+        return logits1, {"k": k_new, "v": v_new}
     logits = jnp.einsum(
         "btd,vd->btv", x, params["embed"], preferred_element_type=jnp.float32
     )
@@ -197,10 +211,12 @@ def prefill(
     tokens: jax.Array,
     seq_lens: jax.Array,
     kv_cache: KVCache,
+    last_only: bool = False,
 ) -> tuple[jax.Array, KVCache]:
     """Prefill a padded [B, T] batch. ``seq_lens`` [B] masks right-padding.
 
-    Returns logits [B, T, V] and the filled cache.
+    Returns logits [B, T, V] and the filled cache — or [B, V] (each row's
+    last valid position only) with ``last_only``, the serving path's shape.
     """
     B, T = tokens.shape
     S = kv_cache["k"].shape[2]
@@ -209,7 +225,10 @@ def prefill(
     causal = s[None, None, :] <= positions[:, :, None]  # [B, T, S]
     valid = s[None, None, :] < seq_lens[:, None, None]
     mask = causal & valid
-    return forward(params, cfg, tokens, positions, kv_cache, mask)
+    return forward(
+        params, cfg, tokens, positions, kv_cache, mask,
+        logits_at=seq_lens - 1 if last_only else None,
+    )
 
 
 def decode_step(

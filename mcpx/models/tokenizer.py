@@ -142,10 +142,18 @@ class SentencePieceTokenizer:
 
 
 def make_tokenizer(vocab: str = "byte"):
-    """``model.vocab`` config -> tokenizer: "byte" (in-tree, default) or
+    """``model.vocab`` config -> tokenizer: "byte" (in-tree, default),
+    "bpe"/"bpe:<path>" (in-tree trained subword vocab, models/bpe.py) or
     "sp:<path-to-model>" (SentencePiece checkpoint vocab)."""
     if vocab in ("", "byte"):
         return ByteTokenizer()
+    if vocab == "bpe" or vocab.startswith("bpe:"):
+        from mcpx.models.bpe import BPETokenizer
+
+        return BPETokenizer(vocab[4:] or None)
     if vocab.startswith("sp:"):
         return SentencePieceTokenizer(vocab[3:])
-    raise ValueError(f"unknown tokenizer spec {vocab!r}; expected 'byte' or 'sp:<path>'")
+    raise ValueError(
+        f"unknown tokenizer spec {vocab!r}; expected 'byte', 'bpe[:<path>]' "
+        "or 'sp:<path>'"
+    )
