@@ -50,7 +50,8 @@ def _snap(eng):
 
 
 async def run_one(*, model: str, n_req: int, batch: int, tick: int, spec: int,
-                  with_keys: bool, depth: int, vocab: str) -> dict:
+                  with_keys: bool, depth: int, vocab: str, minfree: int,
+                  wait: float) -> dict:
     from mcpx.core.config import MCPXConfig
     from mcpx.engine.engine import InferenceEngine
     from mcpx.planner.grammar import build_plan_grammar
@@ -71,6 +72,8 @@ async def run_one(*, model: str, n_req: int, batch: int, tick: int, spec: int,
                 "decode_steps_per_tick": tick,
                 "speculate_k": spec,
                 "pipeline_depth": depth,
+                "admit_min_free": minfree,
+                "admit_max_wait_s": wait,
             },
         }
     )
@@ -109,7 +112,8 @@ async def run_one(*, model: str, n_req: int, batch: int, tick: int, spec: int,
     gen = sum(r.generated_tokens for r in results)
     out = {
         "model": model, "batch": batch, "tick": tick, "spec": spec,
-        "depth": depth, "vocab": vocab, "keys": int(with_keys), "requests": n_req,
+        "depth": depth, "vocab": vocab, "minfree": minfree, "wait": wait,
+        "keys": int(with_keys), "requests": n_req,
         "plans_per_sec": round(n_req / dt, 2),
         "elapsed_s": round(dt, 2),
         "startup_s": round(t_start, 1),
@@ -140,6 +144,8 @@ def _base() -> dict:
         "with_keys": os.environ.get("PROBE_KEYS", "1") == "1",
         "depth": int(os.environ.get("PROBE_DEPTH", "2")),
         "vocab": os.environ.get("PROBE_VOCAB", "bpe"),
+        "minfree": int(os.environ.get("PROBE_MINFREE", "0")),
+        "wait": float(os.environ.get("PROBE_WAIT", "0.15")),
     }
 
 
@@ -156,8 +162,10 @@ async def main() -> None:
                     c["with_keys"] = v == "1"
                 elif k == "requests":
                     c["n_req"] = int(v)
-                elif k in ("tick", "spec", "batch", "depth"):
+                elif k in ("tick", "spec", "batch", "depth", "minfree"):
                     c[k] = int(v)
+                elif k == "wait":
+                    c["wait"] = float(v)
                 elif k == "model":
                     c["model"] = v
                 elif k == "vocab":

@@ -51,7 +51,8 @@ def _config(model_size: str, max_batch: int = 32):
 
     return MCPXConfig.from_dict(
         {
-            "model": {"size": model_size, "max_seq_len": 2048},
+            # Same serving vocab as bench.py: in-tree BPE (models/bpe.py).
+            "model": {"size": model_size, "max_seq_len": 2048, "vocab": "bpe"},
             "engine": {
                 "max_batch_size": max_batch,
                 "max_decode_len": 96,

@@ -755,49 +755,32 @@ class InferenceEngine:
                 slab.t_decode0[i] = now
 
     def _dispatch_merge(self, slab: "_Slab", rows: list[int]) -> None:
-        """Dispatch one merge scatter for ``rows`` (+ any dirty retired
-        rows) into the device slab state. Async — no round trip."""
+        """Dispatch one clear-scatter for ``rows`` + any dirty retired rows
+        into the device slab state: every named row gets the free-row state
+        (done, pad cur, zeroed page-table row → null page). Admitted rows
+        take the OTHER merge (``_admit_merge_impl``, device-chained values);
+        this one only ever clears. Async — no round trip."""
         B = slab.B
-        dirty = [i for i in self._dirty_rows if i not in rows]
+        targets = list(dict.fromkeys(list(rows) + list(self._dirty_rows)))
         self._dirty_rows.clear()
-        n = len(rows) + len(dirty)
-        if n == 0:
+        if not targets:
             return
         idx = np.full((B,), B, np.int32)  # B = dropped padding
-        cur_v = np.full((B,), slab.pad_id, np.int32)
-        pos_v = np.zeros((B,), np.int32)
-        st_v = np.zeros((B,), np.int32)
-        e_v = np.zeros((B,), np.int32)
-        done_v = np.ones((B,), bool)
-        budgets_v = np.zeros((B,), np.int32)
-        pt_v = np.zeros((B, slab.page_table.shape[1]), np.int32)
-        buf_v = np.full((B, slab.steps), slab.pad_id, np.int32)
-        for j, i in enumerate(rows):
-            idx[j] = i
-            cur_v[j] = slab.cur[i]
-            pos_v[j] = slab.pos[i]
-            st_v[j] = slab.st[i]
-            e_v[j] = slab.emitted[i]
-            done_v[j] = slab.done[i]
-            budgets_v[j] = slab.budgets[i]
-            pt_v[j] = slab.page_table[i]
-            buf_v[j] = slab.out_buf[i]
-        for j, i in enumerate(dirty, start=len(rows)):
-            idx[j] = i  # retired row: defaults above are exactly the clear
+        idx[: len(targets)] = targets
         rs = self._row_spec(B)
         rs2 = self._row_spec(B, 1)
         state = self._dev_state(slab)
         slab.dev = self._jit_merge(
             *state,
             self._put(idx, rs),
-            self._put(cur_v, rs),
-            self._put(pos_v, rs),
-            self._put(st_v, rs),
-            self._put(e_v, rs),
-            self._put(done_v, rs),
-            self._put(budgets_v, rs),
-            self._put(pt_v, rs2),
-            self._put(buf_v, rs2),
+            self._put(np.full((B,), slab.pad_id, np.int32), rs),
+            self._put(np.zeros((B,), np.int32), rs),
+            self._put(np.zeros((B,), np.int32), rs),
+            self._put(np.zeros((B,), np.int32), rs),
+            self._put(np.ones((B,), bool), rs),
+            self._put(np.zeros((B,), np.int32), rs),
+            self._put(np.zeros((B, slab.page_table.shape[1]), np.int32), rs2),
+            self._put(np.full((B, slab.steps), slab.pad_id, np.int32), rs2),
         )
 
     def prompt_capacity(self, max_new_tokens: int = 0, shared_prefix_len: int = 0) -> int:
@@ -1571,10 +1554,8 @@ class InferenceEngine:
             # into the admit-merge). EOS-at-first-sample rows retire empty
             # at their first harvest (emitted=0 via the merge).
             slab.pos[i] = P + int(seq_lens[j])
-            slab.emitted[i] = 1
             slab.done[i] = False
             slab.budgets[i] = budgets_np[j]
-            slab.out_buf[i, :] = tok.pad_id
             slab.page_table[i, :] = table[j]
             slab.queue_ms[i] = (t0 - r.enqueued_at) * 1e3
             slab.prefill_ms[i] = -1.0  # resolved by _poll_admissions

@@ -442,13 +442,14 @@ def test_sparse_product_matches_dense():
     dense = G.build_plan_grammar(tok, names, input_keys=keys)
     assert dense.transitions is not None  # small vocab -> dense path
 
-    # Force the sparse path by shrinking the dense-entries budget.
-    old = G._DENSE_ENTRIES_MAX
-    G._DENSE_ENTRIES_MAX = 1
+    # Force the sparse path by shrinking the dense-entries budgets
+    # (subword vocabs gate on _DENSE_SUBWORD_MAX since the BPE speedup).
+    old = G._DENSE_ENTRIES_MAX, G._DENSE_SUBWORD_MAX
+    G._DENSE_ENTRIES_MAX = G._DENSE_SUBWORD_MAX = 1
     try:
         sparse = G.build_plan_grammar(tok, names, input_keys=keys)
     finally:
-        G._DENSE_ENTRIES_MAX = old
+        G._DENSE_ENTRIES_MAX, G._DENSE_SUBWORD_MAX = old
     assert sparse.transitions is None  # sparse path taken
 
     assert sparse.min_len == dense.min_len
@@ -487,8 +488,9 @@ def test_sparse_free_strings_exceed_budget():
     import mcpx.planner.grammar as G
 
     tok = _subword_tok([f"piece{i}" for i in range(50)])
-    old_dense, old_budget = G._DENSE_ENTRIES_MAX, G._SPARSE_VISIT_BUDGET
-    G._DENSE_ENTRIES_MAX = 1
+    old_dense = G._DENSE_ENTRIES_MAX, G._DENSE_SUBWORD_MAX
+    old_budget = G._SPARSE_VISIT_BUDGET
+    G._DENSE_ENTRIES_MAX = G._DENSE_SUBWORD_MAX = 1
     G._SPARSE_VISIT_BUDGET = 300
     try:
         import pytest
@@ -498,5 +500,5 @@ def test_sparse_free_strings_exceed_budget():
             # visit budget at this (artificially tiny) setting
             G.build_plan_grammar(tok, ["alpha-svc"])
     finally:
-        G._DENSE_ENTRIES_MAX = old_dense
+        G._DENSE_ENTRIES_MAX, G._DENSE_SUBWORD_MAX = old_dense
         G._SPARSE_VISIT_BUDGET = old_budget
