@@ -69,37 +69,39 @@ class BPETokenizer:
         raw = len(self._surfaces)
         self.n_real = raw
         self.vocab_size = ((raw + _MXU_PAD - 1) // _MXU_PAD) * _MXU_PAD
-        # Longest-match index: first byte -> {surface: id}, tried longest
-        # first. Single bytes are the universal fallback.
-        self._max_len = max(len(s) for s in merged) if merged else 1
-        by_first: dict[int, list[tuple[bytes, int]]] = {}
+        # Longest-match byte trie: node = {byte: child}, with the token id
+        # ending at a node stored under the -1 key. Encoding walks bytes
+        # forward remembering the deepest token match — O(len * avg_depth)
+        # dict lookups, vs the naive per-candidate startswith scan that
+        # profiled as the single hottest function on the /plan host path.
+        self._trie: dict = {}
         for tid, s in enumerate(self._surfaces):
             if s is None or len(s) < 2:
                 continue
-            by_first.setdefault(s[0], []).append((s, tid))
-        self._by_first = {
-            b: sorted(v, key=lambda e: -len(e[0])) for b, v in by_first.items()
-        }
+            node = self._trie
+            for b in s:
+                node = node.setdefault(b, {})
+            node[-1] = tid
 
     def encode(self, text: str, *, bos: bool = True, eos: bool = False) -> list[int]:
         data = text.encode("utf-8")
         ids: list[int] = [BOS_ID] if bos else []
+        trie = self._trie
         i, n = 0, len(data)
         while i < n:
-            cands = self._by_first.get(data[i])
-            if cands:
-                window = data[i : i + self._max_len]
-                for s, tid in cands:
-                    if window.startswith(s):
-                        ids.append(tid)
-                        i += len(s)
-                        break
-                else:
-                    ids.append(data[i])
-                    i += 1
-            else:
-                ids.append(data[i])
-                i += 1
+            node = trie.get(data[i])
+            best_id, best_end = data[i], i + 1  # single byte always matches
+            j = i + 1
+            while node is not None:
+                tid = node.get(-1)
+                if tid is not None:
+                    best_id, best_end = tid, j
+                if j >= n:
+                    break
+                node = node.get(data[j])
+                j += 1
+            ids.append(best_id)
+            i = best_end
         if eos:
             ids.append(EOS_ID)
         return ids
