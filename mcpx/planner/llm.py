@@ -143,11 +143,22 @@ class LLMPlanner:
         # can make smaller than the full-prefill one.
         tok = self.engine.tokenizer
         prefix_ids = tok.encode(_PROMPT_HEADER)
-        prompt, head_chars = self._prompt(
-            intent, services, context, prefix_len=len(prefix_ids)
-        )
-        assert prompt[:head_chars] == _PROMPT_HEADER
-        prompt_ids = prefix_ids + tok.encode(prompt[head_chars:], bos=False)
+        # Token-exact clamp (a char-level clamp is exact only on the byte
+        # vocab; subword vocabs pack ~3-8 chars/token and would starve the
+        # prompt of shortlist lines). Render, encode, and cut the kept
+        # service list proportionally to the token overshoot — monotone
+        # shrink, converges in ~2 render+encode passes (~0.1ms each).
+        budget = self._token_budget(len(prefix_ids))
+        kept = services[: max(1, budget)]  # a line costs >=1 token
+        while True:
+            prompt, head_chars = self._prompt(intent, kept, context)
+            assert prompt[:head_chars] == _PROMPT_HEADER
+            suffix_ids = tok.encode(prompt[head_chars:], bos=False)
+            total = len(prefix_ids) + len(suffix_ids)
+            if total <= budget or len(kept) <= 1:
+                break
+            kept = kept[: max(1, min(len(kept) - 1, len(kept) * budget // total))]
+        prompt_ids = prefix_ids + suffix_ids
 
         last_problems: list[str] = []
         for attempt in range(self.config.max_plan_retries + 1):
@@ -303,20 +314,31 @@ class LLMPlanner:
         )
         return None
 
+    def _token_budget(self, prefix_len: int) -> int:
+        """Prompt token budget: config cap clamped to what the engine can
+        hold next to the decode budget (minus 1 for BOS). getattr: test
+        fakes implement only generate()/tokenizer."""
+        capacity_fn = getattr(self.engine, "prompt_capacity", None)
+        budget = self.config.max_prompt_tokens
+        if capacity_fn is not None:
+            try:
+                budget = min(budget, capacity_fn(0, prefix_len) - 1)
+            except TypeError:  # older/fake engines: no prefix parameter
+                budget = min(budget, capacity_fn() - 1)
+        return budget
+
     def _prompt(
         self,
         intent: str,
         services: list[ServiceRecord],
         context: PlanContext,
-        prefix_len: int = 0,
     ) -> tuple[str, int]:
-        """Compact prompt: shortlist + telemetry features + intent, trimmed to
-        ``max_prompt_tokens`` (byte tokenizer: 1 token ≈ 1 char). Returns
-        (text, header_chars) where the first ``header_chars`` are the fixed
-        instruction header (``_PROMPT_HEADER``) shared verbatim by every
-        request — the engine's shared-prefix KV cache keys on it.
-        ``prefix_len`` is the header's token length, used to clamp against
-        the engine's prefix-path capacity."""
+        """Compact prompt: shortlist + telemetry features + intent, rendered
+        for EXACTLY the given services — all length clamping is the caller's
+        token-exact loop (``plan``). Returns (text, header_chars) where the
+        first ``header_chars`` are the fixed instruction header
+        (``_PROMPT_HEADER``) shared verbatim by every request — the engine's
+        shared-prefix KV cache keys on it."""
         header = _PROMPT_HEADER[:-1]  # strip trailing \n; joined back below
         lines = header.split("\n")
         for s in services:
@@ -340,30 +362,6 @@ class LLMPlanner:
         lines.append(f"Intent: {intent}")
         lines.append("JSON:")
         text = "\n".join(lines)
-        # Clamp to what the engine can actually hold next to the decode
-        # budget (minus 1 for BOS): the planner's trim preserves the header
-        # and intent lines, the engine's safety trim cannot — so the clamp
-        # must happen HERE for those lines to survive large registries.
-        # getattr: test fakes implement only generate()/tokenizer.
-        capacity_fn = getattr(self.engine, "prompt_capacity", None)
-        budget = self.config.max_prompt_tokens
-        if capacity_fn is not None:
-            try:
-                budget = min(budget, capacity_fn(0, prefix_len) - 1)
-            except TypeError:  # older/fake engines: no prefix parameter
-                budget = min(budget, capacity_fn() - 1)
-        if len(text) > budget:
-            # Drop whole service lines from the tail of the list (lowest
-            # retrieval rank) until the prompt fits; intent always survives.
-            head, tail = lines[:2], lines[2:-2]
-            fixed = len("\n".join(head)) + len("\n".join(lines[-2:])) + 2
-            kept: list[str] = []
-            for line in tail:
-                if fixed + len(line) + 1 > budget:
-                    break
-                kept.append(line)
-                fixed += len(line) + 1
-            text = "\n".join(head + kept + lines[-2:])
         # Fixed header = the instruction + "Services:" lines INCLUDING the
         # trailing newline, identical for every request against any registry.
         header_chars = len(lines[0]) + 1 + len(lines[1]) + 1

@@ -352,3 +352,42 @@ def test_repair_prunes_dangling_and_backward_next():
         assert "repaired" in plan.explanation
 
     asyncio.run(go())
+
+
+def test_token_exact_clamp_packs_subword_prompts():
+    """With a subword vocab the clamp is token-exact: the prompt may exceed
+    the budget in CHARS (impossible under the old 1-char=1-token clamp) while
+    its encoding stays within the token budget, so shortlist lines that a
+    char clamp would drop survive."""
+
+    async def go():
+        from mcpx.models.tokenizer import make_tokenizer
+
+        reg = await _registry()
+        for i in range(30):
+            await reg.put(
+                ServiceRecord(
+                    name=f"catalog-fetch-{i:04d}",
+                    endpoint=f"http://x/{i}",
+                    input_schema={"query": "str", "user_id": "str"},
+                    output_schema={"status": "str"},
+                )
+            )
+        eng = FakeEngine([GOOD])
+        eng.tokenizer = make_tokenizer("bpe")
+        budget = 160
+        p = LLMPlanner(eng, PlannerConfig(kind="llm", max_prompt_tokens=budget))
+        ctx = PlanContext(
+            registry=reg,
+            shortlist=[f"catalog-fetch-{i:04d}" for i in range(30)],
+        )
+        await p.plan("fetch the catalog things", ctx)
+        prompt = eng.prompts[0]
+        n_tokens = len(eng.tokenizer.encode(prompt))
+        assert n_tokens <= budget, n_tokens
+        assert len(prompt) > budget  # chars exceed the token budget: packed
+        assert prompt.count("\ncatalog-fetch-") >= 8  # far more than a char clamp keeps
+        assert prompt.rstrip().endswith("JSON:")
+        assert "fetch the catalog things" in prompt
+
+    asyncio.run(go())
