@@ -1224,6 +1224,7 @@ class InferenceEngine:
             if self._stop:
                 break
             self._poll_admissions(slab)
+            self._reap_cancelled(slab)
             if pending and slab.n_active < slab.B:
                 try:
                     self._admit(slab, pending)
@@ -1422,6 +1423,11 @@ class InferenceEngine:
         defer: list[GenerateRequest] = []
         while pending and len(cohort) < len(free):
             r = pending.popleft()
+            if r.future.cancelled():
+                # Abandoned while queued (client disconnect / timeout):
+                # skipping here saves the prefill compute and pages that
+                # _reap_cancelled would otherwise claw back a tick later.
+                continue
             if not slab.compatible(r) or (
                 head_key is not None and r.prefix_key(ecfg.kv_page_size) != head_key
             ):
@@ -1589,6 +1595,25 @@ class InferenceEngine:
         )
         self.metrics.kv_page_utilization.set(self._allocator.stats().utilization)
         self.metrics.batch_occupancy.set(slab.n_active)
+
+    def _reap_cancelled(self, slab: "_Slab") -> None:
+        """Free rows whose request future was cancelled (client disconnect,
+        server-side timeout): pages return to the allocator now and the row
+        re-admits immediately instead of decoding an abandoned plan to
+        budget exhaustion. The device row keeps decoding harmlessly until
+        the next merge zeroes its page-table row — the same freed-page
+        safety argument as retirement (garbage writes land in pages that
+        cannot be reused before that merge), and the generation bump keeps
+        lagged harvests off the row's next occupant."""
+        for i in range(slab.B):
+            r = slab.req[i]
+            if r is None or not r.future.cancelled():
+                continue
+            self._allocator.free(slab.sid[i])
+            slab.clear_row(i)
+            self._dirty_rows.add(i)
+            self.metrics.reaped_rows.inc()
+            self.metrics.batch_occupancy.set(slab.n_active)
 
     def _dispatch_segment(self, slab: "_Slab") -> None:
         """Dispatch one decode segment chained on the device slab state and

@@ -155,9 +155,12 @@ class LLMPlanner:
             assert prompt[:head_chars] == _PROMPT_HEADER
             suffix_ids = tok.encode(prompt[head_chars:], bos=False)
             total = len(prefix_ids) + len(suffix_ids)
-            if total <= budget or len(kept) <= 1:
+            # Zero services is a legal floor: a header+intent prompt that
+            # FITS beats an over-budget one whose tail (the Intent/JSON:
+            # cue) the engine's head-keep safety trim would cut.
+            if total <= budget or not kept:
                 break
-            kept = kept[: max(1, min(len(kept) - 1, len(kept) * budget // total))]
+            kept = kept[: min(len(kept) - 1, len(kept) * budget // total)]
         prompt_ids = prefix_ids + suffix_ids
 
         last_problems: list[str] = []

@@ -90,6 +90,13 @@ class Metrics:
             "Requests admitted into slab rows",
             registry=self.registry,
         )
+        self.reaped_rows = Counter(
+            "mcpx_engine_reaped_rows_total",
+            "Slab rows freed early because their request was cancelled "
+            "(client disconnect / server timeout) — decode capacity a "
+            "non-reaping engine would waste finishing abandoned plans",
+            registry=self.registry,
+        )
         self.segment_active_rows = Counter(
             "mcpx_engine_segment_active_rows_total",
             "Sum of live slab rows at each decode segment "

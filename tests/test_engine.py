@@ -440,3 +440,41 @@ def test_shared_prefix_matches_full_prefill():
             await eng_pfx.aclose()
 
     asyncio.run(go())
+
+
+def test_cancelled_request_reaps_row_and_pages():
+    """A cancelled request (client disconnect / server timeout) frees its
+    slab row and pages at the next tick instead of decoding the abandoned
+    plan to budget exhaustion — and the engine keeps serving afterwards."""
+
+    async def go():
+        eng = make_engine(decode_steps_per_tick=1, speculate_k=0)
+        await eng.start()
+        try:
+            prompt = eng.tokenizer.encode("cancel me: compose. JSON:")
+            t = asyncio.create_task(eng.generate(prompt, max_new_tokens=96))
+            for _ in range(300):
+                await asyncio.sleep(0.01)
+                if eng._slab.n_active >= 1:
+                    break
+            assert eng._slab.n_active >= 1
+            t.cancel()
+            try:
+                await t
+            except asyncio.CancelledError:
+                pass
+            # The worker reaps the row at a tick boundary.
+            for _ in range(300):
+                await asyncio.sleep(0.01)
+                if eng._allocator.stats().sequences == 0:
+                    break
+            assert eng._allocator.stats().sequences == 0
+            assert eng.metrics.reaped_rows._value.get() == 1
+            eng._allocator.check_invariants()
+            # Service continues: a fresh request still completes.
+            res = await eng.generate(prompt, max_new_tokens=24)
+            assert res.generated_tokens > 0
+        finally:
+            await eng.aclose()
+
+    asyncio.run(go())
