@@ -88,15 +88,22 @@ def _build_config(model_size: str):
             "model": {"size": model_size, "max_seq_len": 2048, "vocab": "bpe"},
             "engine": {
                 "max_batch_size": 64,
-                "max_decode_len": 96,
+                # Decode budget is an INFORMATION budget: 40 BPE tokens carry
+                # more JSON than the 96 byte-tokens the old config allowed
+                # (measured ~6-8 chars/token on plan text). Oversizing it
+                # lets the grammar emit sprawling plans and multiplies decode
+                # forwards per request (probe: budget 96 cost 2.5x the
+                # forwards of 32 for the same request count).
+                "max_decode_len": 40,
                 # 64-token pages: measured 1.6x faster decode than 16-token
                 # pages (4x fewer page DMAs per attention program) with no
                 # fragmentation cost at this workload's uniform lengths.
                 "kv_page_size": 64,
-                # Sized to the workload: 768-token prompt bucket + 96 decode
-                # + speculation slack; oversizing the page table inflates
-                # every attention gather.
-                "max_pages_per_seq": 16,
+                # Sized to the workload: BPE prompts fit the 128-token
+                # prefill bucket + the 40-token decode budget + speculation
+                # slack in 4 x 64-token pages; oversizing the page table
+                # inflates every attention gather.
+                "max_pages_per_seq": 4,
                 "temperature": 0.0,
                 "use_pallas": True,
                 # Pallas kernels need a real TPU; interpret mode on CPU.
@@ -110,8 +117,8 @@ def _build_config(model_size: str):
                 # One constrained decode per plan; validation failures repair
                 # via the heuristic (worst-case cost path for random weights).
                 "max_plan_retries": 0,
-                # 6-way shortlist keeps the compact prompt inside the
-                # 768-token prefill bucket (8-way spills into 1024).
+                # 6-way shortlist keeps the compact BPE prompt inside the
+                # 128-token prefill bucket.
                 "shortlist_top_k": 6,
             },
         }

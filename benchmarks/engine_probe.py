@@ -51,7 +51,7 @@ def _snap(eng):
 
 async def run_one(*, model: str, n_req: int, batch: int, tick: int, spec: int,
                   with_keys: bool, depth: int, vocab: str, minfree: int,
-                  wait: float) -> dict:
+                  wait: float, budget: int) -> dict:
     from mcpx.core.config import MCPXConfig
     from mcpx.engine.engine import InferenceEngine
     from mcpx.planner.grammar import build_plan_grammar
@@ -61,7 +61,7 @@ async def run_one(*, model: str, n_req: int, batch: int, tick: int, spec: int,
             "model": {"size": model, "max_seq_len": 2048, "vocab": vocab},
             "engine": {
                 "max_batch_size": batch,
-                "max_decode_len": 96,
+                "max_decode_len": budget,
                 "kv_page_size": 64,
                 "max_pages_per_seq": 16,
                 "temperature": 0.0,
@@ -100,11 +100,11 @@ async def run_one(*, model: str, n_req: int, batch: int, tick: int, spec: int,
     # Warm every admission-cohort bucket the timed phase could hit, so no
     # XLA compile lands inside the measured window.
     for a in eng._batch_buckets:
-        await asyncio.gather(*(eng.generate(ids, max_new_tokens=96, grammar=grammar)
+        await asyncio.gather(*(eng.generate(ids, max_new_tokens=budget, grammar=grammar)
                                for _ in range(a)))
     m0 = _snap(eng)
     t1 = time.monotonic()
-    results = await asyncio.gather(*(eng.generate(ids, max_new_tokens=96, grammar=grammar)
+    results = await asyncio.gather(*(eng.generate(ids, max_new_tokens=budget, grammar=grammar)
                                      for _ in range(n_req)))
     dt = time.monotonic() - t1
     m1 = _snap(eng)
@@ -113,6 +113,7 @@ async def run_one(*, model: str, n_req: int, batch: int, tick: int, spec: int,
     out = {
         "model": model, "batch": batch, "tick": tick, "spec": spec,
         "depth": depth, "vocab": vocab, "minfree": minfree, "wait": wait,
+        "budget": budget,
         "keys": int(with_keys), "requests": n_req,
         "plans_per_sec": round(n_req / dt, 2),
         "elapsed_s": round(dt, 2),
@@ -146,6 +147,7 @@ def _base() -> dict:
         "vocab": os.environ.get("PROBE_VOCAB", "bpe"),
         "minfree": int(os.environ.get("PROBE_MINFREE", "0")),
         "wait": float(os.environ.get("PROBE_WAIT", "0.15")),
+        "budget": int(os.environ.get("PROBE_BUDGET", "96")),
     }
 
 
@@ -162,7 +164,7 @@ async def main() -> None:
                     c["with_keys"] = v == "1"
                 elif k == "requests":
                     c["n_req"] = int(v)
-                elif k in ("tick", "spec", "batch", "depth", "minfree"):
+                elif k in ("tick", "spec", "batch", "depth", "minfree", "budget"):
                     c[k] = int(v)
                 elif k == "wait":
                     c["wait"] = float(v)

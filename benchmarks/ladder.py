@@ -55,9 +55,11 @@ def _config(model_size: str, max_batch: int = 32):
             "model": {"size": model_size, "max_seq_len": 2048, "vocab": "bpe"},
             "engine": {
                 "max_batch_size": max_batch,
-                "max_decode_len": 96,
+                # Information budget on the BPE vocab (see bench.py): 48
+                # subword tokens >= the plan JSON 96 byte-tokens held.
+                "max_decode_len": 48,
                 "kv_page_size": 64,
-                "max_pages_per_seq": 20,
+                "max_pages_per_seq": 6,
                 "temperature": 0.0,
                 "use_pallas": _on_tpu(),
                 "warmup_compile": _on_tpu(),

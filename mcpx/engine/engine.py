@@ -1596,6 +1596,17 @@ class InferenceEngine:
         self.metrics.kv_page_utilization.set(self._allocator.stats().utilization)
         self.metrics.batch_occupancy.set(slab.n_active)
 
+    def _release_row(self, slab: "_Slab", i: int) -> None:
+        """The one row-release sequence (pages back to the allocator, host
+        clear + generation bump, device page-table row marked dirty, gauges
+        refreshed) shared by retirement, reaping and failure cleanup — the
+        release invariant must not drift between those paths."""
+        self._allocator.free(slab.sid[i])
+        slab.clear_row(i)
+        self._dirty_rows.add(i)
+        self.metrics.kv_page_utilization.set(self._allocator.stats().utilization)
+        self.metrics.batch_occupancy.set(slab.n_active)
+
     def _reap_cancelled(self, slab: "_Slab") -> None:
         """Free rows whose request future was cancelled (client disconnect,
         server-side timeout): pages return to the allocator now and the row
@@ -1609,11 +1620,8 @@ class InferenceEngine:
             r = slab.req[i]
             if r is None or not r.future.cancelled():
                 continue
-            self._allocator.free(slab.sid[i])
-            slab.clear_row(i)
-            self._dirty_rows.add(i)
+            self._release_row(slab, i)
             self.metrics.reaped_rows.inc()
-            self.metrics.batch_occupancy.set(slab.n_active)
 
     def _dispatch_segment(self, slab: "_Slab") -> None:
         """Dispatch one decode segment chained on the device slab state and
@@ -1697,16 +1705,8 @@ class InferenceEngine:
                 self.metrics.engine_queue_seconds.observe(res.queue_ms / 1e3)
                 self.metrics.engine_prefill_seconds.observe(res.prefill_ms / 1e3)
                 self.metrics.engine_decode_seconds.observe(res.decode_ms / 1e3)
-                self._allocator.free(slab.sid[i])
-                slab.clear_row(i)
-                self._dirty_rows.add(i)
-                retired = True
+                self._release_row(slab, i)
                 r.loop.call_soon_threadsafe(_resolve, r.future, res, None)
-            if retired:
-                self.metrics.kv_page_utilization.set(
-                    self._allocator.stats().utilization
-                )
-                self.metrics.batch_occupancy.set(slab.n_active)
 
     def _init_pools(self) -> dict:
         """Fresh zeroed KV page pools, sharded over the mesh: KV heads on
