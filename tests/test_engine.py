@@ -478,3 +478,43 @@ def test_cancelled_request_reaps_row_and_pages():
             await eng.aclose()
 
     asyncio.run(go())
+
+
+def test_mid_serving_failure_fails_rows_and_recovers():
+    """A device/runtime failure inside a decode segment fails the in-flight
+    requests with the ORIGINAL exception (callers can match the concrete
+    type), resets the KV pools, clears the pipeline (in-flight handles,
+    dirty rows, pending admissions) — and the very next request serves
+    normally (SURVEY.md §5 failure detection: degrade loudly, recover
+    without restart)."""
+
+    async def go():
+        eng = make_engine()
+        await eng.start()
+        try:
+            prompt = eng.tokenizer.encode("will fail mid-decode. JSON:")
+            real_segment = eng._jit_segment
+            calls = {"n": 0}
+
+            def boom(*a, **kw):
+                calls["n"] += 1
+                raise RuntimeError("injected device failure")
+
+            eng._jit_segment = boom
+            # The caller sees the ORIGINAL device error, not a wrapper.
+            with pytest.raises(RuntimeError, match="injected device failure"):
+                await eng.generate(prompt, max_new_tokens=24)
+            assert calls["n"] >= 1
+            assert not eng._inflight and not eng._pending_admissions
+            assert eng._allocator.stats().sequences == 0
+            eng._allocator.check_invariants()
+
+            # Restore the device path: service resumes with fresh pools.
+            eng._jit_segment = real_segment
+            res = await eng.generate(prompt, max_new_tokens=24)
+            assert res.generated_tokens > 0
+            assert eng.grammar.walk(res.text) != eng.grammar.dead_state
+        finally:
+            await eng.aclose()
+
+    asyncio.run(go())
