@@ -186,6 +186,11 @@ class PlannerConfig:
     shortlist_top_k: int = 8
     max_prompt_tokens: int = 1536
     plan_cache_size: int = 4096
+    # Optional second cache tier shared across replicas and restarts
+    # (server/plan_cache.py): "" disables. Keys embed the registry version,
+    # so registry changes invalidate implicitly.
+    plan_cache_redis_url: str = ""
+    plan_cache_redis_ttl_s: float = 600.0
     explain: bool = True
     # Trie-constrain the grammar's service-name positions (VERDICT r1 #2):
     #   "registry"  — one grammar over ALL registry names per registry

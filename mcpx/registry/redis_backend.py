@@ -25,13 +25,14 @@ class RedisRegistry(RegistryBackend):
 
     def _redis(self):
         if self._client is None:
+            from mcpx.utils.redis_client import lazy_redis_client
+
             try:
-                import redis.asyncio as aioredis  # type: ignore
-            except ImportError as e:  # pragma: no cover - env without redis
-                raise RegistryError(
-                    "registry.backend=redis requires the 'redis' package, which is not installed"
-                ) from e
-            self._client = aioredis.from_url(self._url)
+                self._client = lazy_redis_client(
+                    self._url, "registry.backend=redis"
+                )
+            except RuntimeError as e:
+                raise RegistryError(str(e)) from e
         return self._client
 
     async def get(self, name: str) -> Optional[ServiceRecord]:

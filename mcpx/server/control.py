@@ -38,6 +38,7 @@ class ControlPlane:
         retriever: Any = None,  # mcpx.retrieval.Index (duck-typed: async shortlist(intent, k))
         replan_policy: Optional[ReplanPolicy] = None,
         telemetry_mirror: Any = None,  # mcpx.telemetry.mirror.RedisTelemetryMirror
+        redis_plan_cache: Any = None,  # mcpx.server.plan_cache.RedisPlanCache
     ) -> None:
         self.config = config or MCPXConfig()
         self.registry = registry
@@ -48,6 +49,7 @@ class ControlPlane:
         self.retriever = retriever
         self.replan_policy = replan_policy or ReplanPolicy(self.config.telemetry)
         self.telemetry_mirror = telemetry_mirror
+        self.redis_plan_cache = redis_plan_cache
         self._plan_cache: OrderedDict[tuple[str, int], Plan] = OrderedDict()
 
     # ------------------------------------------------------------- lifecycle
@@ -82,6 +84,14 @@ class ControlPlane:
                 self._plan_cache.move_to_end(key)
                 self.metrics.plan_cache.labels(result="hit").inc()
                 return cached, (time.monotonic() - t0) * 1e3
+            if self.redis_plan_cache is not None:
+                # Second tier: shared across replicas/restarts; a hit here
+                # still warms the local LRU.
+                shared = await self.redis_plan_cache.get(intent, version)
+                if shared is not None:
+                    self._cache_put(key, shared)
+                    self.metrics.plan_cache.labels(result="redis_hit").inc()
+                    return shared, (time.monotonic() - t0) * 1e3
             self.metrics.plan_cache.labels(result="miss").inc()
 
         context = await self._context(intent, version=version)
@@ -99,6 +109,8 @@ class ControlPlane:
             raise
         if use_cache and self.config.planner.plan_cache_size > 0:
             self._cache_put(key, plan)
+            if self.redis_plan_cache is not None:
+                await self.redis_plan_cache.put(intent, version, plan)
         return plan, (time.monotonic() - t0) * 1e3
 
     def _cache_put(self, key: tuple[str, int], plan: Plan) -> None:
@@ -163,9 +175,14 @@ class ControlPlane:
                 break  # nothing viable left to route around; keep last result
             result = await self.execute(plan, payload, trace)
         if trace.replans and result.status == "ok" and self.config.planner.plan_cache_size > 0:
-            # The repaired plan is the one worth caching; otherwise every
-            # request for this intent repeats the fail->replan cycle.
-            self._cache_put((intent, await self.registry.version()), plan)
+            # The repaired plan is the one worth caching — in BOTH tiers;
+            # a stale failing plan left in Redis would keep re-warming every
+            # replica's LRU (this one included, after eviction) with the
+            # plan that triggers the fail->replan cycle.
+            version = await self.registry.version()
+            self._cache_put((intent, version), plan)
+            if self.redis_plan_cache is not None:
+                await self.redis_plan_cache.put(intent, version, plan)
         return {
             "graph": plan.to_wire(),
             "results": result.results,

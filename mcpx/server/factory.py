@@ -60,6 +60,14 @@ def build_control_plane(
         from mcpx.telemetry.mirror import RedisTelemetryMirror
 
         telemetry_mirror = RedisTelemetryMirror(telemetry, config.telemetry.redis_url)
+    redis_plan_cache = None
+    if config.planner.plan_cache_redis_url:
+        from mcpx.server.plan_cache import RedisPlanCache
+
+        redis_plan_cache = RedisPlanCache(
+            config.planner.plan_cache_redis_url,
+            ttl_s=config.planner.plan_cache_redis_ttl_s,
+        )
     metrics = Metrics()
     orchestrator = Orchestrator(
         transport,
@@ -91,4 +99,5 @@ def build_control_plane(
         retriever=retriever,
         replan_policy=ReplanPolicy(config.telemetry),
         telemetry_mirror=telemetry_mirror,
+        redis_plan_cache=redis_plan_cache,
     )

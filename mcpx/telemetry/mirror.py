@@ -49,14 +49,9 @@ class RedisTelemetryMirror:
 
     def _redis(self):
         if self._client is None:
-            try:
-                import redis.asyncio as aioredis  # type: ignore
-            except ImportError as e:  # pragma: no cover - env without redis
-                raise RuntimeError(
-                    "telemetry.redis_url requires the 'redis' package, which "
-                    "is not installed"
-                ) from e
-            self._client = aioredis.from_url(self._url)
+            from mcpx.utils.redis_client import lazy_redis_client
+
+            self._client = lazy_redis_client(self._url, "telemetry.redis_url")
         return self._client
 
     # ------------------------------------------------------------------ api

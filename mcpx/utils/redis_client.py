@@ -1,0 +1,26 @@
+"""Shared lazy Redis client constructor.
+
+One place for the deferred-import pattern every Redis-touching component
+uses (registry backend, telemetry mirror, plan cache): no import-time side
+effects (reference bug B8), and bounded socket timeouts so an unresponsive
+— not refusing — Redis raises into each caller's "cache/mirror is an
+optimisation" handling instead of black-holing the hot path forever.
+"""
+
+from __future__ import annotations
+
+
+def lazy_redis_client(url: str, setting_name: str, *, timeout_s: float = 1.0):
+    """Build an async Redis client for ``url``. Raises RuntimeError naming
+    ``setting_name`` when the optional ``redis`` package is absent."""
+    try:
+        import redis.asyncio as aioredis  # type: ignore
+    except ImportError as e:  # pragma: no cover - env without redis
+        raise RuntimeError(
+            f"{setting_name} requires the 'redis' package, which is not installed"
+        ) from e
+    return aioredis.from_url(
+        url,
+        socket_timeout=timeout_s,
+        socket_connect_timeout=timeout_s,
+    )

@@ -107,3 +107,83 @@ def test_cache_metrics_counters():
         assert hit == 1.0 and miss == 1.0
 
     asyncio.run(go())
+
+
+def test_redis_plan_cache_shared_across_replicas():
+    """The Redis tier (SURVEY.md §5: plans persist across restarts and are
+    shared between replicas): replica B serves replica A's plan without
+    invoking its own planner; a registry bump invalidates (version is in the
+    key); corrupt entries read as misses."""
+    from mcpx.server.plan_cache import RedisPlanCache
+    from mcpx.telemetry.mirror import FakeAsyncRedis
+
+    async def go():
+        shared = FakeAsyncRedis()
+        cfg = MCPXConfig.from_dict(
+            {
+                "planner": {"kind": "mock", "plan_cache_redis_url": "redis://unused"},
+                "retrieval": {"enabled": False},
+            }
+        )
+        pa, pb = CountingPlanner(), CountingPlanner()
+        cpa = build_control_plane(cfg, planner=pa)
+        cpb = build_control_plane(cfg, planner=pb)
+        assert cpa.redis_plan_cache is not None  # factory wired the tier
+        cpa.redis_plan_cache._client = shared
+        cpb.redis_plan_cache._client = shared
+        for cp in (cpa, cpb):
+            await cp.registry.put(
+                ServiceRecord(name="svc", endpoint="local://svc")
+            )
+        assert await cpa.registry.version() == await cpb.registry.version()
+
+        plan_a, _ = await cpa.plan("do the thing")
+        assert pa.calls == 1
+        plan_b, _ = await cpb.plan("do the thing")
+        assert pb.calls == 0  # served from the shared tier
+        assert plan_b.to_wire() == plan_a.to_wire()
+
+        # Registry mutation on B: new version -> shared entry is stale.
+        await cpb.registry.put(ServiceRecord(name="svc2", endpoint="local://svc2"))
+        await cpb.plan("do the thing")
+        assert pb.calls == 1
+
+        # Corrupt entry reads as a miss, not an error.
+        key = cpa.redis_plan_cache._key("broken", await cpa.registry.version())
+        await shared.set(key, "{not json")
+        assert await cpa.redis_plan_cache.get(
+            "broken", await cpa.registry.version()
+        ) is None
+
+    asyncio.run(go())
+
+
+def test_redis_plan_cache_wrong_shape_is_miss_and_subsecond_ttl():
+    """Valid-JSON wrong-shape entries (another build's schema, corruption)
+    read as misses — never raise into the plan request — and sub-second
+    TTLs round up to 1s instead of becoming 'no expiry'."""
+    from mcpx.server.plan_cache import RedisPlanCache
+    from mcpx.telemetry.mirror import FakeAsyncRedis
+
+    async def go():
+        redis = FakeAsyncRedis()
+        cache = RedisPlanCache("redis://unused", ttl_s=0.5, client=redis)
+        await redis.set(cache._key("x", 1), '{"nodes": 5}')
+        assert await cache.get("x", 1) is None
+        await redis.set(cache._key("y", 1), '{"nodes": [{"name": "a", "params": 5}]}')
+        assert await cache.get("y", 1) is None
+
+        seen = {}
+        real_set = redis.set
+
+        async def spy_set(key, value, ex=None):
+            seen["ex"] = ex
+            await real_set(key, value, ex=ex)
+
+        redis.set = spy_set
+        from mcpx.core.dag import linear_plan
+
+        await cache.put("z", 1, linear_plan(["a"]))
+        assert seen["ex"] == 1  # 0.5s rounds UP, not down to no-expiry
+
+    asyncio.run(go())
