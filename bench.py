@@ -312,7 +312,10 @@ async def _run(model_size: str, n_requests: int, concurrency: int, n_services: i
 
     sat_sorted = sorted(sat_lat)
     open_sorted = sorted(open_lat) or [float("nan")]  # latency phase may be skipped
+    import jax
+
     return {
+        "backend": jax.default_backend(),
         "plans_per_sec": plans_per_sec,
         "p50_ms": statistics.median(open_sorted),
         "p99_ms": open_sorted[int(0.99 * (len(open_sorted) - 1))],
@@ -342,7 +345,55 @@ def _on_tpu() -> bool:
     return jax.default_backend() not in ("cpu",)
 
 
+def _device_guard() -> None:
+    """Probe device availability in a SUBPROCESS with a timeout before this
+    process touches JAX. The axon tunnel's failure mode when the TPU server
+    holds a dead session is a silent in-process HANG inside make_c_api_client
+    (uninterruptible once entered), not an exception — observed after a
+    device-OOM crash wedged the relay for hours. A degraded CPU bench line
+    beats a driver-killing hang."""
+    import subprocess
+
+    timeout_s = float(os.environ.get("MCPX_BENCH_DEVICE_TIMEOUT_S", "120"))
+    try:
+        # Popen + poll, NOT subprocess.run: run()'s timeout path kills the
+        # child then blocks in communicate()/wait() — a child stuck in a
+        # D-state kernel hang survives SIGKILL and would hang the parent
+        # right back. No pipes (DEVNULL), bounded poll, then abandon.
+        proc = subprocess.Popen(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                break
+            time.sleep(0.5)
+        if proc.poll() is None:
+            proc.kill()  # best-effort; deliberately NOT waited on
+            raise TimeoutError(f"device probe exceeded {timeout_s}s")
+        if proc.returncode != 0:
+            raise RuntimeError(f"device probe exited {proc.returncode}")
+        return
+    except Exception as e:  # noqa: BLE001 - any probe failure -> CPU fallback
+        print(
+            f"bench: device probe failed ({type(e).__name__}); falling back to "
+            "an 8-device virtual CPU platform (model=test) — NOT a TPU number",
+            file=sys.stderr,
+        )
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from __graft_entry__ import _force_virtual_cpu
+
+        _force_virtual_cpu(8)
+        os.environ.setdefault("MCPX_BENCH_MODEL", "test")
+        os.environ.setdefault("MCPX_BENCH_REQUESTS", "64")
+        os.environ.setdefault("MCPX_BENCH_CONCURRENCY", "32")
+        os.environ.setdefault("MCPX_BENCH_LATENCY_REQUESTS", "24")
+
+
 def main() -> None:
+    _device_guard()
     model = os.environ.get("MCPX_BENCH_MODEL")
     n_requests = int(os.environ.get("MCPX_BENCH_REQUESTS", "512"))
     concurrency = int(os.environ.get("MCPX_BENCH_CONCURRENCY", "256"))
@@ -383,6 +434,7 @@ def main() -> None:
                     k: round(v, 1) for k, v in stats["phase_p50_ms"].items()
                 },
                 "model": model,
+                "backend": stats["backend"],
                 "n_services": n_services,
                 "requests": n_requests,
                 "errors": stats["errors"],

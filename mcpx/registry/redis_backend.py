@@ -28,8 +28,11 @@ class RedisRegistry(RegistryBackend):
             from mcpx.utils.redis_client import lazy_redis_client
 
             try:
+                # Correctness path (not an optional cache): generous bound —
+                # fail a registry op loudly after 5s rather than hanging
+                # forever on a stalled Redis.
                 self._client = lazy_redis_client(
-                    self._url, "registry.backend=redis"
+                    self._url, "registry.backend=redis", timeout_s=5.0
                 )
             except RuntimeError as e:
                 raise RegistryError(str(e)) from e

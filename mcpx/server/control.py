@@ -51,6 +51,7 @@ class ControlPlane:
         self.telemetry_mirror = telemetry_mirror
         self.redis_plan_cache = redis_plan_cache
         self._plan_cache: OrderedDict[tuple[str, int], Plan] = OrderedDict()
+        self._cache_writes: set = set()  # in-flight shared-tier writes
 
     # ------------------------------------------------------------- lifecycle
     async def startup(self) -> None:
@@ -78,20 +79,24 @@ class ControlPlane:
         t0 = time.monotonic()
         version = await self.registry.version()
         key = (intent, version)
-        if use_cache and self.config.planner.plan_cache_size > 0:
+        local_tier = self.config.planner.plan_cache_size > 0
+        if use_cache and local_tier:
             cached = self._plan_cache.get(key)
             if cached is not None:
                 self._plan_cache.move_to_end(key)
                 self.metrics.plan_cache.labels(result="hit").inc()
                 return cached, (time.monotonic() - t0) * 1e3
-            if self.redis_plan_cache is not None:
-                # Second tier: shared across replicas/restarts; a hit here
-                # still warms the local LRU.
-                shared = await self.redis_plan_cache.get(intent, version)
-                if shared is not None:
+        if use_cache and self.redis_plan_cache is not None:
+            # Second tier: shared across replicas/restarts, independent of
+            # the local LRU (plan_cache_size=0 disables only the local
+            # tier); a hit here still warms the LRU when enabled.
+            shared = await self.redis_plan_cache.get(intent, version)
+            if shared is not None:
+                if local_tier:
                     self._cache_put(key, shared)
-                    self.metrics.plan_cache.labels(result="redis_hit").inc()
-                    return shared, (time.monotonic() - t0) * 1e3
+                self.metrics.plan_cache.labels(result="redis_hit").inc()
+                return shared, (time.monotonic() - t0) * 1e3
+        if use_cache and (local_tier or self.redis_plan_cache is not None):
             self.metrics.plan_cache.labels(result="miss").inc()
 
         context = await self._context(intent, version=version)
@@ -109,9 +114,20 @@ class ControlPlane:
             raise
         if use_cache and self.config.planner.plan_cache_size > 0:
             self._cache_put(key, plan)
-            if self.redis_plan_cache is not None:
-                await self.redis_plan_cache.put(intent, version, plan)
+        if use_cache and self.redis_plan_cache is not None:
+            self._redis_cache_write(intent, version, plan)
         return plan, (time.monotonic() - t0) * 1e3
+
+    def _redis_cache_write(self, intent: str, version: int, plan: Plan) -> None:
+        """Fire-and-forget write to the shared tier: put() swallows its own
+        errors, and the plan response must not wait out a slow Redis. The
+        task set keeps references so the event loop can't GC in-flight
+        writes."""
+        import asyncio
+
+        task = asyncio.create_task(self.redis_plan_cache.put(intent, version, plan))
+        self._cache_writes.add(task)
+        task.add_done_callback(self._cache_writes.discard)
 
     def _cache_put(self, key: tuple[str, int], plan: Plan) -> None:
         self._plan_cache[key] = plan
@@ -174,15 +190,16 @@ class ControlPlane:
             except Exception:
                 break  # nothing viable left to route around; keep last result
             result = await self.execute(plan, payload, trace)
-        if trace.replans and result.status == "ok" and self.config.planner.plan_cache_size > 0:
-            # The repaired plan is the one worth caching — in BOTH tiers;
-            # a stale failing plan left in Redis would keep re-warming every
-            # replica's LRU (this one included, after eviction) with the
-            # plan that triggers the fail->replan cycle.
+        if trace.replans and result.status == "ok":
+            # The repaired plan is the one worth caching — in EVERY enabled
+            # tier; a stale failing plan left in Redis would keep re-warming
+            # every replica's LRU (this one included, after eviction) with
+            # the plan that triggers the fail->replan cycle.
             version = await self.registry.version()
-            self._cache_put((intent, version), plan)
+            if self.config.planner.plan_cache_size > 0:
+                self._cache_put((intent, version), plan)
             if self.redis_plan_cache is not None:
-                await self.redis_plan_cache.put(intent, version, plan)
+                self._redis_cache_write(intent, version, plan)
         return {
             "graph": plan.to_wire(),
             "results": result.results,

@@ -12,7 +12,13 @@ from __future__ import annotations
 
 def lazy_redis_client(url: str, setting_name: str, *, timeout_s: float = 1.0):
     """Build an async Redis client for ``url``. Raises RuntimeError naming
-    ``setting_name`` when the optional ``redis`` package is absent."""
+    ``setting_name`` when the optional ``redis`` package is absent.
+
+    ``timeout_s`` should match the caller's tolerance: optional components
+    (telemetry mirror, plan cache) keep the tight default so a stalled
+    Redis degrades them instead of the hot path; the registry — a
+    correctness dependency — passes a larger value, trading "fail loudly
+    after a bounded wait" against redis-py's default of hanging forever."""
     try:
         import redis.asyncio as aioredis  # type: ignore
     except ImportError as e:  # pragma: no cover - env without redis

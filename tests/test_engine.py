@@ -463,9 +463,12 @@ def test_cancelled_request_reaps_row_and_pages():
                 await t
             except asyncio.CancelledError:
                 pass
-            # The worker reaps the row at a tick boundary.
-            for _ in range(300):
-                await asyncio.sleep(0.01)
+            # The worker reaps the row at a tick boundary — but a tick can
+            # be stretched by a multi-second on-demand XLA CPU compile
+            # (warmup_compile is off in tests), so the window must outlast
+            # a compile, not just a decode step.
+            for _ in range(1200):
+                await asyncio.sleep(0.05)
                 if eng._allocator.stats().sequences == 0:
                     break
             assert eng._allocator.stats().sequences == 0

@@ -139,6 +139,8 @@ def test_redis_plan_cache_shared_across_replicas():
 
         plan_a, _ = await cpa.plan("do the thing")
         assert pa.calls == 1
+        # Shared-tier writes are fire-and-forget; flush before reading.
+        await asyncio.gather(*cpa._cache_writes)
         plan_b, _ = await cpb.plan("do the thing")
         assert pb.calls == 0  # served from the shared tier
         assert plan_b.to_wire() == plan_a.to_wire()
