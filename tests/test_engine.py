@@ -523,3 +523,47 @@ def test_mid_serving_failure_fails_rows_and_recovers():
             await eng.aclose()
 
     asyncio.run(go())
+
+
+def test_cancelled_queued_request_never_admitted():
+    """A request cancelled while still QUEUED behind a full slab is skipped
+    at admission (no prefill, no pages) instead of being admitted and then
+    reaped; live requests around it complete normally."""
+
+    async def go():
+        eng = make_engine(max_batch_size=2, decode_steps_per_tick=1, speculate_k=0)
+        await eng.start()
+        try:
+            tok = eng.tokenizer
+            long_ = [
+                asyncio.create_task(
+                    eng.generate(tok.encode(f"occupy row {i}. JSON:"), max_new_tokens=96)
+                )
+                for i in range(2)
+            ]
+            for _ in range(1200):
+                await asyncio.sleep(0.05)
+                if eng._slab.n_active == 2:
+                    break
+            assert eng._slab.n_active == 2  # slab full; next request queues
+            queued = asyncio.create_task(
+                eng.generate(tok.encode("queued then abandoned"), max_new_tokens=96)
+            )
+            await asyncio.sleep(0.05)
+            queued.cancel()
+            try:
+                await queued
+            except asyncio.CancelledError:
+                pass
+            results = await asyncio.gather(*long_)
+            for r in results:
+                assert r.generated_tokens > 0
+            # The abandoned request was never admitted: only the two
+            # occupants were ever given rows, and nothing leaked.
+            assert eng.metrics.admitted_rows._value.get() == 2
+            assert eng._allocator.stats().sequences == 0
+            eng._allocator.check_invariants()
+        finally:
+            await eng.aclose()
+
+    asyncio.run(go())
