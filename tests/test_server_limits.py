@@ -80,3 +80,64 @@ def test_mock_planner_no_aliasing():
         assert plan.intent == ""  # template untouched
 
     asyncio.run(go())
+
+
+def test_plan_timeout_reaps_engine_row_and_capacity_recovers():
+    """The server's request timeout (504) must also FREE the engine row the
+    abandoned /plan occupied — the wait_for cancellation propagates into the
+    engine future and the worker reaps the row — so a later request gets
+    the capacity instead of queueing behind a zombie decode."""
+
+    async def go():
+        cfg = MCPXConfig.from_dict(
+            {
+                "model": {"size": "test", "max_seq_len": 256},
+                "server": {"request_timeout_s": 0.4},
+                "planner": {"kind": "llm", "max_plan_retries": 0},
+                "retrieval": {"enabled": False},
+                "engine": {
+                    "use_pallas": False,
+                    "max_batch_size": 1,  # a single row: a zombie would block ALL capacity
+                    "max_decode_len": 96,
+                    "kv_page_size": 16,
+                    "max_pages_per_seq": 16,
+                    "temperature": 0.0,
+                    "decode_steps_per_tick": 1,
+                    "speculate_k": 0,
+                },
+            }
+        )
+        from mcpx.registry.base import ServiceRecord
+
+        cp = build_control_plane(cfg)
+        await cp.registry.put(ServiceRecord(name="svc-a", endpoint="local://svc-a"))
+        await cp.startup()
+        eng = cp.planner.engine
+
+        async def drive(client):
+            r = await client.post("/plan", json={"intent": "slow plan please"})
+            assert r.status == 504  # byte-vocab 96-token decode outlasts 0.4s on CPU
+            # The engine reaps the abandoned row at a tick boundary. The
+            # planner's shared-prefix KV entry legitimately stays resident
+            # (refs 0, evictable) — only ROW sequences must drain.
+            def row_seqs():
+                return eng._allocator.stats().sequences - len(eng._prefix_cache)
+
+            for _ in range(1200):
+                await asyncio.sleep(0.05)
+                if row_seqs() == 0 and eng._slab.n_active == 0:
+                    break
+            # The capacity property, not the mechanism: depending on where
+            # the cancellation lands the row is reaped mid-decode, skipped
+            # at admission, or retired — in every case the single slab row
+            # must come back and the engine must still serve.
+            assert row_seqs() == 0 and eng._slab.n_active == 0
+            res = await eng.generate(
+                eng.tokenizer.encode("quick"), max_new_tokens=4
+            )
+            assert res.generated_tokens > 0
+
+        await with_client(build_app(cp), drive)
+        await eng.aclose()
+
+    asyncio.run(go())
