@@ -1,0 +1,9 @@
+#!/bin/bash
+# One TPU tunnel session, headline first: the axon tunnel admits one client
+# process at a time (a second blocks silently), so run everything in order
+# from a single shell. Usage: bash benchmarks/tpu_session.sh
+set -x
+cd "$(dirname "$0")/.."
+python bench.py 2>&1 | tail -3
+PROBE_SWEEP="budget=40;budget=32;budget=48;budget=40,tick=2;budget=40,minfree=1;budget=40,minfree=16;budget=40,spec=4;budget=40,depth=3" \
+  timeout 3500 python benchmarks/engine_probe.py 2>&1 | grep -E '^\{'
