@@ -85,6 +85,10 @@ def _build_config(model_size: str):
             # In-tree BPE vocab (models/bpe.py): ~6x fewer prompt tokens and
             # ~8x fewer plan tokens than the byte vocab — prefill drops from
             # the 512-token bucket to 128, decode from ~90 to ~20 tokens.
+            # CAVEAT: the committed vocab is trained on this bench's own
+            # synthetic registry distribution (bpe.py docstring); real
+            # registries with different naming compress materially worse —
+            # real-checkpoint serving uses the SentencePiece vocab instead.
             "model": {"size": model_size, "max_seq_len": 2048, "vocab": "bpe"},
             "engine": {
                 "max_batch_size": 64,
@@ -105,9 +109,13 @@ def _build_config(model_size: str):
                 # inflates every attention gather.
                 "max_pages_per_seq": 4,
                 "temperature": 0.0,
-                "use_pallas": True,
-                # Pallas kernels need a real TPU; interpret mode on CPU.
-                "interpret": False,
+                # Derived from the live backend (like benchmarks/ladder.py):
+                # after the _device_guard CPU fallback, a pinned
+                # MCPX_BENCH_MODEL=2b (head_dim 256 passes the Pallas
+                # alignment check) must not run Mosaic TPU kernels on the
+                # CPU backend — the CPU path serves the fused-jnp
+                # reference attention instead.
+                "use_pallas": _on_tpu(),
                 # Compile every (A, T) bucket before serving: the timed
                 # region must contain zero XLA compiles.
                 "warmup_compile": True,
@@ -352,29 +360,18 @@ def _device_guard() -> None:
     (uninterruptible once entered), not an exception — observed after a
     device-OOM crash wedged the relay for hours. A degraded CPU bench line
     beats a driver-killing hang."""
-    import subprocess
-
     timeout_s = float(os.environ.get("MCPX_BENCH_DEVICE_TIMEOUT_S", "120"))
     try:
-        # Popen + poll, NOT subprocess.run: run()'s timeout path kills the
-        # child then blocks in communicate()/wait() — a child stuck in a
-        # D-state kernel hang survives SIGKILL and would hang the parent
-        # right back. No pipes (DEVNULL), bounded poll, then abandon.
-        proc = subprocess.Popen(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            stdout=subprocess.DEVNULL,
-            stderr=subprocess.DEVNULL,
+        # The Popen/bounded-poll/abandon pattern (and its rationale: no
+        # pipes, never wait on a possibly-D-state child) lives in ONE
+        # place — benchmarks/tunnel_probe.py.
+        sys.path.insert(
+            0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "benchmarks")
         )
-        deadline = time.monotonic() + timeout_s
-        while time.monotonic() < deadline:
-            if proc.poll() is not None:
-                break
-            time.sleep(0.5)
-        if proc.poll() is None:
-            proc.kill()  # best-effort; deliberately NOT waited on
-            raise TimeoutError(f"device probe exceeded {timeout_s}s")
-        if proc.returncode != 0:
-            raise RuntimeError(f"device probe exited {proc.returncode}")
+        from tunnel_probe import probe
+
+        if not probe(timeout_s):
+            raise TimeoutError(f"device probe failed/exceeded {timeout_s}s")
         return
     except Exception as e:  # noqa: BLE001 - any probe failure -> CPU fallback
         print(

@@ -1,0 +1,45 @@
+"""Guarded TPU-tunnel liveness probe.
+
+The axon relay's failure mode is a silent uninterruptible hang inside
+``make_c_api_client`` (see BASELINE.md round-3 caveat), so the probe runs
+``jax.devices()`` in a SUBPROCESS with a bounded poll and abandons it on
+timeout — the parent never touches JAX. Exit 0 = tunnel alive, 1 = wedged.
+
+Usage: ``python benchmarks/tunnel_probe.py [timeout_s]``
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+
+
+def probe(timeout_s: float = 60.0) -> bool:
+    """True iff a fresh process can initialize the default JAX backend
+    within ``timeout_s``. Shared by bench.py's ``_device_guard`` — keep the
+    Popen/bounded-poll/abandon pattern in ONE place. No pipes (DEVNULL):
+    a child stuck in a D-state kernel hang survives SIGKILL, and an
+    unread pipe would add a second way to wedge; liveness is conveyed by
+    the exit code alone."""
+    proc = subprocess.Popen(
+        [sys.executable, "-c", "import jax; jax.devices()"],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            break
+        time.sleep(0.5)
+    if proc.poll() is None:
+        proc.kill()  # best-effort; NOT waited on (D-state survives SIGKILL)
+        return False
+    return proc.returncode == 0
+
+
+if __name__ == "__main__":
+    t = float(sys.argv[1]) if len(sys.argv) > 1 else 60.0
+    ok = probe(t)
+    print("tunnel:", "ALIVE" if ok else "WEDGED")
+    sys.exit(0 if ok else 1)

@@ -243,7 +243,8 @@ class InferenceEngine:
         self._prefix_cache: "OrderedDict[tuple, _Prefix]" = OrderedDict()
         # Pipelined segment outputs awaiting their (lagged) flag fetch:
         # entries are (done, emitted, out_buf, n_fwd device handles,
-        # gen snapshot, dispatch wall time). Worker thread only.
+        # gen snapshot); decode wall time is taken at harvest. Worker
+        # thread only.
         self._inflight: "deque[tuple]" = deque()
         # Rows retired on the host whose DEVICE page-table rows still point
         # at freed pages; zeroed (scatter to the null page) in the next
@@ -745,6 +746,16 @@ class InferenceEngine:
         while self._pending_admissions:
             t0, marker, rows, gens = self._pending_admissions[0]
             if not marker.is_ready():
+                # Purge entries whose rows were ALL cancelled/reaped before
+                # the marker resolved — otherwise they hold device handles
+                # across an idle block in _drain_queue (n_active==0, no
+                # inflight) until the next request arrives.
+                if all(
+                    slab.req[i] is None or slab.gen[i] != g
+                    for i, g in zip(rows, gens)
+                ):
+                    self._pending_admissions.pop(0)
+                    continue
                 return
             self._pending_admissions.pop(0)
             dt = (now - t0) * 1e3
