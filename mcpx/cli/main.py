@@ -79,6 +79,40 @@ def cmd_gen_registry(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_train_planner(args: argparse.Namespace) -> int:
+    """Train the in-tree planner model on the synthetic workload corpus and
+    write a committable single-file .npz checkpoint (models/train.py)."""
+    import time
+
+    from mcpx.models.corpus import CorpusConfig, build_corpus_sync
+    from mcpx.models.gemma.config import GemmaConfig
+    from mcpx.models.tokenizer import make_tokenizer
+    from mcpx.models.train import TrainConfig, save_npz, train
+
+    tok = make_tokenizer(args.vocab)
+    ccfg = CorpusConfig(
+        n_examples=args.examples, registry_size=args.registry, seed=args.seed
+    )
+    t0 = time.time()
+    corpus = build_corpus_sync(tok, ccfg)
+    print(
+        f"corpus: {corpus.tokens.shape[0]} rows (dropped {corpus.n_dropped}) "
+        f"in {time.time() - t0:.1f}s"
+    )
+    cfg = GemmaConfig.named(args.size, vocab_size=tok.vocab_size)
+    tcfg = TrainConfig(
+        steps=args.steps, batch_size=args.batch, lr=args.lr, seed=args.seed
+    )
+    t0 = time.time()
+    params, report = train(
+        cfg, corpus, tcfg, log_fn=lambda m: print(m, flush=True)
+    )
+    print(f"trained {args.steps} steps in {time.time() - t0:.0f}s: {report}")
+    save_npz(args.out, params)
+    print(f"wrote {args.out}")
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="mcpx")
     parser.add_argument("--config", help="JSON config file")
@@ -99,6 +133,20 @@ def main(argv: list[str] | None = None) -> int:
     p_gen.add_argument("--out", default="registry.json")
     p_gen.add_argument("--seed", type=int, default=0)
     p_gen.set_defaults(func=cmd_gen_registry)
+
+    p_train = sub.add_parser(
+        "train-planner", help="train the in-tree planner model (synthetic corpus)"
+    )
+    p_train.add_argument("--out", default="mcpx/models/checkpoints/planner_test_bpe.npz")
+    p_train.add_argument("--size", default="test")
+    p_train.add_argument("--vocab", default="bpe")
+    p_train.add_argument("--examples", type=int, default=4096)
+    p_train.add_argument("--registry", type=int, default=1000)
+    p_train.add_argument("--steps", type=int, default=2500)
+    p_train.add_argument("--batch", type=int, default=24)
+    p_train.add_argument("--lr", type=float, default=3e-3)
+    p_train.add_argument("--seed", type=int, default=0)
+    p_train.set_defaults(func=cmd_train_planner)
 
     args = parser.parse_args(argv)
     return args.func(args)

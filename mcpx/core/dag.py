@@ -329,6 +329,25 @@ class Plan:
     def to_json(self, **kw: Any) -> str:
         return json.dumps(self.to_wire(), **kw)
 
+    def to_steps_json(self) -> str:
+        """Serialise to the compact grammar wire shape the constrained
+        decoder emits (``planner/grammar.py``):
+
+            {"steps":[{"s":svc,"in":[keys],"next":[svcs]},...]}
+
+        Byte-compatible with the plan grammar's DFA (no whitespace, fixed
+        key order), so a round trip through ``from_json`` is exact on the
+        step structure. Used as the teacher-forcing target format by the
+        planner-model training corpus (``models/corpus.py``)."""
+        succ: dict[str, list[str]] = {n.name: [] for n in self.nodes}
+        for e in self.edges:
+            succ[e.src].append(e.dst)
+        steps = [
+            {"s": n.name, "in": sorted(n.inputs), "next": succ[n.name]}
+            for n in self.nodes
+        ]
+        return json.dumps({"steps": steps}, separators=(",", ":"))
+
 
 def linear_plan(service_names: Iterable[str], intent: str = "") -> Plan:
     """Convenience: a linear chain DAG over ``service_names`` in order."""

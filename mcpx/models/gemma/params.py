@@ -21,6 +21,29 @@ from mcpx.models.gemma.model import Params, init_params
 from mcpx.parallel.mesh import param_pspecs
 
 
+def _check_shapes(params: Params, cfg: GemmaConfig, path: str) -> None:
+    """Loaded tree must match the config's shapes exactly — a silent
+    mismatch (e.g. a checkpoint trained on a different vocab) would either
+    crash deep inside jit or, worse, broadcast."""
+    expected = jax.eval_shape(lambda: init_params(cfg, jax.random.PRNGKey(0)))
+    flat_e = jax.tree.leaves_with_path(expected)
+    flat_p = {jax.tree_util.keystr(k): v for k, v in jax.tree.leaves_with_path(params)}
+    problems = []
+    expected_keys = set()
+    for key, exp in flat_e:
+        ks = jax.tree_util.keystr(key)
+        expected_keys.add(ks)
+        got = flat_p.get(ks)
+        if got is None:
+            problems.append(f"missing {ks}")
+        elif tuple(got.shape) != tuple(exp.shape):
+            problems.append(f"{ks}: shape {tuple(got.shape)} != {tuple(exp.shape)}")
+    for ks in sorted(set(flat_p) - expected_keys):
+        problems.append(f"unexpected {ks}")
+    if problems:
+        raise EngineError(f"checkpoint {path} does not fit model config: {problems[:4]}")
+
+
 def save_checkpoint(path: str, params: Params) -> None:
     path = os.path.abspath(path)
     with ocp.PyTreeCheckpointer() as ckptr:
@@ -35,6 +58,18 @@ def load_checkpoint(
     path = os.path.abspath(path)
     if not os.path.exists(path):
         raise EngineError(f"checkpoint not found: {path}")
+    if path.endswith(".npz"):
+        # Single-file trained-planner checkpoint (models/train.py save_npz):
+        # small enough to land fully on host, then shard onto the mesh.
+        from mcpx.models.train import load_npz
+
+        params = load_npz(path)
+        _check_shapes(params, cfg, path)
+        if mesh is not None:
+            from mcpx.parallel.mesh import shard_pytree
+
+            params = shard_pytree(params, param_pspecs(cfg, mesh), mesh)
+        return params
     with ocp.PyTreeCheckpointer() as ckptr:
         if mesh is None:
             return ckptr.restore(path)

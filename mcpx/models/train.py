@@ -1,0 +1,234 @@
+"""Planner-model training: jitted AdamW fine-tune of the in-tree decoder.
+
+The reference has no training code (its planner is a remote pretrained
+model, reference ``control_plane.py:69-73``). This trainer teaches the
+in-tree Gemma-architecture decoder the intent→plan mapping on the
+synthetic workload corpus (``models/corpus.py``) so served plans are
+semantically non-random (VERDICT r3 missing #2).
+
+TPU-first shape:
+  - one jitted ``train_step`` (forward = the model's own ``prefill`` path,
+    shifted masked CE in float32, grad, AdamW update) with donated
+    params/opt state — step time is one device dispatch;
+  - static shapes throughout ([B, L] fixed rows from the corpus packer;
+    the layer stack is the model's own ``lax.scan``);
+  - optional data parallelism: pass a ``Mesh`` and batches are sharded
+    over its ``data`` axis (params replicated — at planner-model sizes
+    replication is free and DP is the only axis worth using);
+  - params train in float32 (tiny model: stability beats memory) and are
+    cast to the serving dtype (bfloat16) at save time.
+
+Checkpoints are single-file ``.npz`` (flattened pytree) — small enough to
+commit, loadable by ``models/gemma/params.py`` onto any serving mesh.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from mcpx.models.gemma.config import GemmaConfig
+from mcpx.models.gemma.model import Params, init_kv_cache, init_params, prefill
+
+
+@dataclass
+class TrainConfig:
+    steps: int = 2000
+    batch_size: int = 32
+    lr: float = 3e-3
+    warmup_steps: int = 100
+    weight_decay: float = 0.01
+    clip_norm: float = 1.0
+    seed: int = 0
+    # Fraction of rows held out for eval (never sampled into train batches).
+    eval_fraction: float = 0.05
+    log_every: int = 100
+
+
+def _loss_fn(
+    params: Params,
+    cfg: GemmaConfig,
+    tokens: jax.Array,  # [B, L]
+    seq_lens: jax.Array,  # [B]
+    loss_mask: jax.Array,  # [B, L] — True at t ⇒ label tokens[t+1] counts
+) -> jax.Array:
+    B, L = tokens.shape
+    kv = init_kv_cache(cfg, B, L, dtype=cfg.dtype)
+    logits, _ = prefill(params, cfg, tokens, seq_lens, kv)  # [B, L, V] f32
+    labels = tokens[:, 1:]
+    lp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(lp, labels[..., None], axis=-1)[..., 0]
+    m = loss_mask[:, :-1].astype(jnp.float32)
+    return -(ll * m).sum() / jnp.maximum(m.sum(), 1.0)
+
+
+def _decay_mask(params: Params):
+    # No weight decay on norm scales (Gemma RMSNorm scales sit at 0 = 1x).
+    return jax.tree.map_with_path(
+        lambda path, _: not any("norm" in str(k) for k in path), params
+    )
+
+
+def train(
+    model_cfg: GemmaConfig,
+    corpus,
+    tcfg: Optional[TrainConfig] = None,
+    *,
+    mesh=None,
+    init: Optional[Params] = None,
+    log_fn=None,
+) -> tuple[Params, dict]:
+    """Train and return (float32 params, report). ``corpus`` is a
+    ``models.corpus.Corpus``; ``mesh`` (optional) shards batches over its
+    ``data`` axis. ``init`` warm-starts from existing params."""
+    tcfg = tcfg or TrainConfig()
+    cfg = dataclasses.replace(model_cfg, dtype="float32")
+    rng = np.random.default_rng(tcfg.seed)
+
+    n = corpus.tokens.shape[0]
+    n_eval = max(1, int(n * tcfg.eval_fraction)) if n > 8 else 0
+    perm = rng.permutation(n)
+    eval_idx, train_idx = perm[:n_eval], perm[n_eval:]
+    if len(train_idx) == 0:
+        raise ValueError("corpus too small to train on")
+
+    params = init or init_params(cfg, jax.random.PRNGKey(tcfg.seed))
+    params = jax.tree.map(lambda a: a.astype(jnp.float32), params)
+
+    sched = optax.warmup_cosine_decay_schedule(
+        0.0, tcfg.lr, tcfg.warmup_steps, max(tcfg.steps, tcfg.warmup_steps + 1)
+    )
+    tx = optax.chain(
+        optax.clip_by_global_norm(tcfg.clip_norm),
+        optax.adamw(sched, weight_decay=tcfg.weight_decay, mask=_decay_mask(params)),
+    )
+    opt_state = tx.init(params)
+
+    batch_sharding = None
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        batch_sharding = NamedSharding(mesh, P("data"))
+        rep = NamedSharding(mesh, P())
+        params = jax.device_put(params, rep)
+        opt_state = jax.device_put(opt_state, rep)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(params, opt_state, tokens, seq_lens, loss_mask):
+        loss, grads = jax.value_and_grad(_loss_fn)(
+            params, cfg, tokens, seq_lens, loss_mask
+        )
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    @jax.jit
+    def eval_step(params, tokens, seq_lens, loss_mask):
+        B, L = tokens.shape
+        kv = init_kv_cache(cfg, B, L, dtype=cfg.dtype)
+        logits, _ = prefill(params, cfg, tokens, seq_lens, kv)
+        pred = jnp.argmax(logits[:, :-1], axis=-1)
+        m = loss_mask[:, :-1]
+        hit = (pred == tokens[:, 1:]) & m
+        return hit.sum(), m.sum()
+
+    def _put(a):
+        return jax.device_put(a, batch_sharding) if batch_sharding is not None else a
+
+    B = tcfg.batch_size
+    losses: list[float] = []
+    loss_log: list[tuple[int, float]] = []
+    for step in range(tcfg.steps):
+        take = rng.choice(train_idx, size=B, replace=len(train_idx) < B)
+        params, opt_state, loss = train_step(
+            params,
+            opt_state,
+            _put(corpus.tokens[take]),
+            _put(corpus.seq_lens[take]),
+            _put(corpus.loss_mask[take]),
+        )
+        losses.append(float(loss))
+        if tcfg.log_every and (step % tcfg.log_every == 0 or step == tcfg.steps - 1):
+            loss_log.append((step, float(loss)))
+            if log_fn is not None:
+                log_fn(f"step {step}/{tcfg.steps} loss {float(loss):.4f}")
+
+    report = {
+        "first_loss": losses[0],
+        "final_loss": float(np.mean(losses[-20:])),
+        "loss_log": loss_log,
+    }
+    if n_eval:
+        hits = tot = 0
+        for s in range(0, n_eval, B):
+            take = eval_idx[s : s + B]
+            h, t = eval_step(
+                params,
+                _put(corpus.tokens[take]),
+                _put(corpus.seq_lens[take]),
+                _put(corpus.loss_mask[take]),
+            )
+            hits += int(h)
+            tot += int(t)
+        report["eval_token_accuracy"] = hits / max(tot, 1)
+    return params, report
+
+
+# ------------------------------------------------------------- checkpoints
+def flatten_params(params: Params, prefix: str = "") -> dict[str, np.ndarray]:
+    out: dict[str, np.ndarray] = {}
+    for k, v in params.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(flatten_params(v, key + "/"))
+        else:
+            out[key] = np.asarray(v)
+    return out
+
+
+def unflatten_params(flat: dict) -> Params:
+    tree: Params = {}
+    for key, v in flat.items():
+        parts = key.split("/")
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = v
+    return tree
+
+
+def save_npz(path: str, params: Params, dtype: str = "bfloat16") -> None:
+    """Serving checkpoint: one compressed .npz, weights cast to the serving
+    dtype. bfloat16 has no numpy dtype, so arrays are stored as uint16
+    bit-patterns under a ``bf16:`` key prefix (decoded by ``load_npz``)."""
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    flat = flatten_params(jax.tree.map(lambda a: jnp.asarray(a), params))
+    blob: dict[str, np.ndarray] = {}
+    for k, v in flat.items():
+        if dtype == "bfloat16":
+            cast = jnp.asarray(v).astype(jnp.bfloat16)
+            blob["bf16:" + k] = np.asarray(cast).view(np.uint16)
+        else:
+            blob[k] = np.asarray(jnp.asarray(v).astype(dtype))
+    np.savez_compressed(path, **blob)
+
+
+def load_npz(path: str) -> Params:
+    """Load a ``save_npz`` checkpoint to host numpy (jax-ready pytree)."""
+    with np.load(path) as z:
+        flat = {}
+        for k in z.files:
+            if k.startswith("bf16:"):
+                arr = jnp.asarray(z[k]).view(jnp.bfloat16)
+                flat[k[len("bf16:") :]] = arr
+            else:
+                flat[k] = z[k]
+    return unflatten_params(flat)

@@ -45,6 +45,81 @@ _PROMPT_HEADER = (
 )
 
 
+def render_prompt(
+    intent: str,
+    services: list[ServiceRecord],
+    context: PlanContext,
+) -> tuple[str, int]:
+    """Compact prompt: shortlist + telemetry features + intent, rendered
+    for EXACTLY the given services — all length clamping is the caller's
+    token-exact loop (``build_prompt_ids``). Returns (text, header_chars)
+    where the first ``header_chars`` are the fixed instruction header
+    (``_PROMPT_HEADER``) shared verbatim by every request — the engine's
+    shared-prefix KV cache keys on it. Module-level (not a planner method)
+    so the training corpus builder (``models/corpus.py``) renders
+    byte-identical prompts to the serving path."""
+    header = _PROMPT_HEADER[:-1]  # strip trailing \n; joined back below
+    lines = header.split("\n")
+    for s in services:
+        feat = ""
+        st = context.telemetry.get(s.name)
+        if st is not None:
+            feat = f" err={st.ewma_error_rate:.2f} p50={st.ewma_latency_ms:.0f}"
+        cost = s.cost_profile.get("cost")
+        if cost is not None:
+            feat += f" c={cost:g}"
+        # Compact per-service line — name, io keys, live features. Prose
+        # descriptions and tags stay OUT of the prompt (they feed the
+        # retrieval embedder instead): with a byte tokenizer every char
+        # is a prefill token, and prefill is the compute-bound side of
+        # the serving cost — trimming a 6-way shortlist from ~480 to
+        # ~400 chars moves it from the 768-token prefill bucket to 512,
+        # a 1.5x cut in prefill FLOPs per plan.
+        ins = ",".join(sorted(s.input_schema))
+        outs = ",".join(sorted(s.output_schema))
+        lines.append(f"{s.name} in:{ins} out:{outs}{feat}")
+    lines.append(f"Intent: {intent}")
+    lines.append("JSON:")
+    text = "\n".join(lines)
+    # Fixed header = the instruction + "Services:" lines INCLUDING the
+    # trailing newline, identical for every request against any registry.
+    header_chars = len(lines[0]) + 1 + len(lines[1]) + 1
+    return text, header_chars
+
+
+def build_prompt_ids(
+    tok,
+    intent: str,
+    services: list[ServiceRecord],
+    context: PlanContext,
+    budget: int,
+    prefix_ids: "list[int] | None" = None,
+) -> tuple[list[int], list[int]]:
+    """(prefix_ids, suffix_ids) for the serving prompt, clamped token-exactly
+    to ``budget`` total. Token-exact (a char-level clamp is exact only on the
+    byte vocab; subword vocabs pack ~3-8 chars/token and would starve the
+    prompt of shortlist lines): render, encode, and cut the kept service list
+    proportionally to the token overshoot — monotone shrink, converges in ~2
+    render+encode passes (~0.1ms each). The prefix is the fixed header,
+    encoded separately so its ids are identical across requests (subword
+    tokenizers are not concatenation-safe at the boundary); callers that
+    already encoded it pass ``prefix_ids``."""
+    if prefix_ids is None:
+        prefix_ids = tok.encode(_PROMPT_HEADER)
+    kept = services[: max(1, budget)]  # a line costs >=1 token
+    while True:
+        prompt, head_chars = render_prompt(intent, kept, context)
+        assert prompt[:head_chars] == _PROMPT_HEADER
+        suffix_ids = tok.encode(prompt[head_chars:], bos=False)
+        total = len(prefix_ids) + len(suffix_ids)
+        # Zero services is a legal floor: a header+intent prompt that
+        # FITS beats an over-budget one whose tail (the Intent/JSON:
+        # cue) the engine's head-keep safety trim would cut.
+        if total <= budget or not kept:
+            break
+        kept = kept[: min(len(kept) - 1, len(kept) * budget // total)]
+    return prefix_ids, suffix_ids
+
 
 class LLMPlanner:
     def __init__(
@@ -143,24 +218,10 @@ class LLMPlanner:
         # can make smaller than the full-prefill one.
         tok = self.engine.tokenizer
         prefix_ids = tok.encode(_PROMPT_HEADER)
-        # Token-exact clamp (a char-level clamp is exact only on the byte
-        # vocab; subword vocabs pack ~3-8 chars/token and would starve the
-        # prompt of shortlist lines). Render, encode, and cut the kept
-        # service list proportionally to the token overshoot — monotone
-        # shrink, converges in ~2 render+encode passes (~0.1ms each).
         budget = self._token_budget(len(prefix_ids))
-        kept = services[: max(1, budget)]  # a line costs >=1 token
-        while True:
-            prompt, head_chars = self._prompt(intent, kept, context)
-            assert prompt[:head_chars] == _PROMPT_HEADER
-            suffix_ids = tok.encode(prompt[head_chars:], bos=False)
-            total = len(prefix_ids) + len(suffix_ids)
-            # Zero services is a legal floor: a header+intent prompt that
-            # FITS beats an over-budget one whose tail (the Intent/JSON:
-            # cue) the engine's head-keep safety trim would cut.
-            if total <= budget or not kept:
-                break
-            kept = kept[: min(len(kept) - 1, len(kept) * budget // total)]
+        prefix_ids, suffix_ids = build_prompt_ids(
+            tok, intent, services, context, budget, prefix_ids=prefix_ids
+        )
         prompt_ids = prefix_ids + suffix_ids
 
         last_problems: list[str] = []
@@ -329,46 +390,6 @@ class LLMPlanner:
             except TypeError:  # older/fake engines: no prefix parameter
                 budget = min(budget, capacity_fn() - 1)
         return budget
-
-    def _prompt(
-        self,
-        intent: str,
-        services: list[ServiceRecord],
-        context: PlanContext,
-    ) -> tuple[str, int]:
-        """Compact prompt: shortlist + telemetry features + intent, rendered
-        for EXACTLY the given services — all length clamping is the caller's
-        token-exact loop (``plan``). Returns (text, header_chars) where the
-        first ``header_chars`` are the fixed instruction header
-        (``_PROMPT_HEADER``) shared verbatim by every request — the engine's
-        shared-prefix KV cache keys on it."""
-        header = _PROMPT_HEADER[:-1]  # strip trailing \n; joined back below
-        lines = header.split("\n")
-        for s in services:
-            feat = ""
-            st = context.telemetry.get(s.name)
-            if st is not None:
-                feat = f" err={st.ewma_error_rate:.2f} p50={st.ewma_latency_ms:.0f}"
-            cost = s.cost_profile.get("cost")
-            if cost is not None:
-                feat += f" c={cost:g}"
-            # Compact per-service line — name, io keys, live features. Prose
-            # descriptions and tags stay OUT of the prompt (they feed the
-            # retrieval embedder instead): with a byte tokenizer every char
-            # is a prefill token, and prefill is the compute-bound side of
-            # the serving cost — trimming a 6-way shortlist from ~480 to
-            # ~400 chars moves it from the 768-token prefill bucket to 512,
-            # a 1.5x cut in prefill FLOPs per plan.
-            ins = ",".join(sorted(s.input_schema))
-            outs = ",".join(sorted(s.output_schema))
-            lines.append(f"{s.name} in:{ins} out:{outs}{feat}")
-        lines.append(f"Intent: {intent}")
-        lines.append("JSON:")
-        text = "\n".join(lines)
-        # Fixed header = the instruction + "Services:" lines INCLUDING the
-        # trailing newline, identical for every request against any registry.
-        header_chars = len(lines[0]) + 1 + len(lines[1]) + 1
-        return text, header_chars
 
     def _repair(self, text: str) -> Optional[Plan]:
         """Bounded, deterministic repair of a grammar-valid but
