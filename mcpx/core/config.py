@@ -92,6 +92,14 @@ class EngineConfig:
     # fuller cohort (an idle slab always admits immediately).
     admit_max_wait_s: float = 0.15
     max_decode_len: int = 512
+    # Long-prompt routing: full prefills whose padded length reaches this
+    # threshold run as sequence-parallel RING prefill (ppermute ring over
+    # the mesh's data devices re-viewed as a seq axis) instead of one
+    # dense [B, T, S]-masked pass. 0 disables. Requires a data axis >= 2;
+    # buckets not divisible by the seq axis fall back to dense. Planner
+    # prompts are short by design (retrieval shortlists, SURVEY.md §5), so
+    # this serves the long-context /plan tail, not the common case.
+    ring_prefill_min_tokens: int = 0
     # Sampling defaults: temperature matches the reference planner call,
     # control_plane.py:72.
     temperature: float = 0.2

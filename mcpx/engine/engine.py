@@ -239,6 +239,7 @@ class InferenceEngine:
         # Device state (worker thread only after start):
         self._params = None
         self._paged_kv = None
+        self._seq_mesh = None
         self._dfa_cache: "OrderedDict[tuple, tuple]" = OrderedDict()
         self._prefix_cache: "OrderedDict[tuple, _Prefix]" = OrderedDict()
         # Pipelined segment outputs awaiting their (lagged) flag fetch:
@@ -351,6 +352,7 @@ class InferenceEngine:
             self._params = None
             self._paged_kv = None
             self._jit_prefill = None
+            self._seq_mesh = None
             self._jit_admit = None
             self._jit_segment = None
             self._jit_suffix_prefill = None
@@ -468,9 +470,29 @@ class InferenceEngine:
             self.model_cfg, self.config.model.checkpoint_path, self._mesh
         )
         self._paged_kv = self._init_pools()
+        # Long-prompt routing (ring prefill): the serving mesh's data
+        # devices double as a seq axis — same device order, so the ring's
+        # ppermute hops ride the neighbouring ICI links the data axis
+        # already occupies. A caller-injected mesh that already carries a
+        # real seq axis is used as-is. Armed only when routing can trigger.
+        self._seq_mesh = None
+        if ecfg.ring_prefill_min_tokens > 0:
+            from jax.sharding import Mesh as _Mesh
+
+            from mcpx.parallel.mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
+
+            n_data = self._mesh.shape.get(DATA_AXIS, 1)
+            n_seq = self._mesh.shape.get(SEQ_AXIS, 1)
+            if n_seq > 1:
+                self._seq_mesh = self._mesh
+            elif n_data > 1:
+                grid = np.asarray(self._mesh.devices).reshape(
+                    1, n_data, self._mesh.shape.get(MODEL_AXIS, 1)
+                )
+                self._seq_mesh = _Mesh(grid, (DATA_AXIS, SEQ_AXIS, MODEL_AXIS))
         self._jit_prefill = jax.jit(
             self._prefill_impl,
-            static_argnames=("T",),
+            static_argnames=("T", "ring"),
             donate_argnames=("paged_k", "paged_v"),
         )
         self._jit_admit = jax.jit(
@@ -555,6 +577,9 @@ class InferenceEngine:
                 # Null page table: scatters land on reserved page 0, which
                 # no live sequence ever reads.
                 table = np.zeros((A, ecfg.max_pages_per_seq), np.int32)
+                # Compile the executable serving will dispatch for this
+                # bucket: ring buckets warm the ring route, not a dense
+                # executable serving would never run.
                 last, k_p, v_p = self._jit_prefill(
                     self._params,
                     self._put(tokens, self._row_spec(A, 1)),
@@ -563,6 +588,7 @@ class InferenceEngine:
                     self._paged_kv["v"],
                     self._put(table, self._row_spec(A, 1)),
                     T=T,
+                    ring=self._ring_ok(T),
                 )
                 self._paged_kv = {"k": k_p, "v": v_p}
                 if ecfg.prefix_cache:
@@ -931,14 +957,29 @@ class InferenceEngine:
         cur0 = jnp.where(done0, tok.pad_id, first)
         return cur0, state0, done0
 
-    def _prefill_impl(self, params, tokens, seq_lens, paged_k, paged_v, page_table, *, T):
+    def _prefill_impl(
+        self, params, tokens, seq_lens, paged_k, paged_v, page_table, *, T, ring=False
+    ):
         cfg = self.model_cfg
         B = tokens.shape[0]
         dense = init_kv_cache(cfg, B, T)
         # last_only: the [B, T, V] logits buffer must never exist — at
         # subword vocab sizes it is hundreds of MB per cohort and its
         # unembed matmul rivals the whole layer stack.
-        last, dense = prefill(params, cfg, tokens, seq_lens, dense, last_only=True)
+        if ring:
+            # Long-prompt route (static flag -> its own executable per T):
+            # the dense causal pass swapped for sequence-parallel ring
+            # attention (parallel/ring_attention.py) — T shards over the
+            # seq mesh (the data devices re-viewed), K/V blocks rotate by
+            # ppermute, softmax accumulates online; no [B, T, S] mask or
+            # score matrix ever exists. Same contract either way.
+            from mcpx.parallel.ring_attention import ring_prefill
+
+            last, dense = ring_prefill(
+                params, cfg, tokens, seq_lens, self._seq_mesh, dense, last_only=True
+            )
+        else:
+            last, dense = prefill(params, cfg, tokens, seq_lens, dense, last_only=True)
         paged = commit_prefill_to_pages(
             {"k": paged_k, "v": paged_v},
             dense,
@@ -947,6 +988,18 @@ class InferenceEngine:
             self.config.engine.kv_page_size,
         )
         return last, paged["k"], paged["v"]
+
+    def _ring_ok(self, T: int) -> bool:
+        """True when a ``T``-token full prefill should take the ring route:
+        threshold met, a real seq mesh exists, and the bucket divides the
+        seq axis. Pure predicate — metric increments stay at serving call
+        sites so warmup compiles don't pollute the counter."""
+        ecfg = self.config.engine
+        if self._seq_mesh is None or T < ecfg.ring_prefill_min_tokens:
+            return False
+        from mcpx.parallel.mesh import SEQ_AXIS
+
+        return T % self._seq_mesh.shape[SEQ_AXIS] == 0
 
     def _suffix_prefill_impl(
         self, params, tokens, seq_lens, positions, page_table, paged_k, paged_v
@@ -1011,6 +1064,12 @@ class InferenceEngine:
         tokens = np.full((1, T), self.tokenizer.pad_id, np.int32)
         tokens[0, :P] = key
         try:
+            # Long shared prefixes are the prime ring workload — route them
+            # like any full prefill (B=1 rides the seq mesh's size-1 data
+            # axis replicated).
+            use_ring = self._ring_ok(T)
+            if use_ring:
+                self.metrics.ring_prefills.inc()
             last, k_p, v_p = self._jit_prefill(
                 self._params,
                 self._put(tokens, self._row_spec(1, 1)),
@@ -1019,6 +1078,7 @@ class InferenceEngine:
                 self._paged_kv["v"],
                 self._put(table, self._row_spec(1, 1)),
                 T=T,
+                ring=use_ring,
             )
             self._paged_kv = {"k": k_p, "v": v_p}
             del last
@@ -1509,6 +1569,9 @@ class InferenceEngine:
                     self._paged_kv["v"],
                 )
             else:
+                use_ring = self._ring_ok(T)
+                if use_ring:
+                    self.metrics.ring_prefills.inc()
                 last_logits, k_p, v_p = self._jit_prefill(
                     self._params,
                     self._put(tokens, self._row_spec(A, 1)),
@@ -1517,6 +1580,7 @@ class InferenceEngine:
                     self._paged_kv["v"],
                     self._put(table, self._row_spec(A, 1)),
                     T=T,
+                    ring=use_ring,
                 )
             # Pools were donated to prefill: point at the live buffers
             # immediately so an exception below can't leave stale handles.

@@ -153,6 +153,7 @@ def ring_prefill(
     seq_lens: jax.Array,  # [B]
     mesh: Mesh,
     kv_cache: Optional[KVCache] = None,
+    last_only: bool = False,
 ) -> tuple[jax.Array, KVCache]:
     """Sequence-parallel prefill: ``model.prefill`` semantics with the
     attention op swapped for ring attention. Token-local compute (embedding,
@@ -161,6 +162,9 @@ def ring_prefill(
 
     The dense [B, T, S] mask is never built; the returned KV cache is the
     standard [L, B, T, K, hd] pytree (seq-sharded on axis 2 under the mesh).
+    ``last_only`` returns [B, V] logits at each row's last valid position
+    (the serving engine's prefill contract — the [B, T, V] buffer never
+    exists).
     """
     B, T = tokens.shape
     if kv_cache is None:
@@ -177,4 +181,7 @@ def ring_prefill(
     # forward() ignores the mask except inside attend_fn; pass a scalar
     # placeholder so no [B, T, S] mask is materialised.
     dummy_mask = jnp.zeros((), bool)
-    return forward(params, cfg, tokens, positions, kv_cache, dummy_mask, attend)
+    return forward(
+        params, cfg, tokens, positions, kv_cache, dummy_mask, attend,
+        logits_at=seq_lens - 1 if last_only else None,
+    )

@@ -106,6 +106,11 @@ class Metrics:
         self.segments = Counter(
             "mcpx_engine_segments_total", "Decode segments run", registry=self.registry
         )
+        self.ring_prefills = Counter(
+            "mcpx_engine_ring_prefills_total",
+            "Full prefills routed through sequence-parallel ring attention",
+            registry=self.registry,
+        )
         self.prefix_hits = Counter(
             "mcpx_engine_prefix_cache_hits_total",
             "Admissions served from a cached shared-prefix KV entry",
