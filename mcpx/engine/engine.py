@@ -477,19 +477,19 @@ class InferenceEngine:
         # real seq axis is used as-is. Armed only when routing can trigger.
         self._seq_mesh = None
         if ecfg.ring_prefill_min_tokens > 0:
-            from jax.sharding import Mesh as _Mesh
-
-            from mcpx.parallel.mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
+            from mcpx.parallel.mesh import DATA_AXIS, SEQ_AXIS
 
             n_data = self._mesh.shape.get(DATA_AXIS, 1)
             n_seq = self._mesh.shape.get(SEQ_AXIS, 1)
             if n_seq > 1:
                 self._seq_mesh = self._mesh
             elif n_data > 1:
-                grid = np.asarray(self._mesh.devices).reshape(
-                    1, n_data, self._mesh.shape.get(MODEL_AXIS, 1)
+                self._seq_mesh = make_mesh(
+                    data=1,
+                    seq=n_data,
+                    model=self._mesh.shape.get("model", 1),
+                    devices=list(self._mesh.devices.flatten()),
                 )
-                self._seq_mesh = _Mesh(grid, (DATA_AXIS, SEQ_AXIS, MODEL_AXIS))
         self._jit_prefill = jax.jit(
             self._prefill_impl,
             static_argnames=("T", "ring"),

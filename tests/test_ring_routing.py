@@ -1,7 +1,7 @@
 """Long-prompt routing through sequence-parallel ring prefill (VERDICT r3
 next #8): the served path, not just the demo kernel — a long prompt admits
-through ``_ring_prefill_impl`` on the seq-viewed mesh and produces the same
-greedy plan as the dense prefill path."""
+through ``InferenceEngine._prefill_impl(ring=True)`` (``ring_prefill`` on
+the seq-viewed mesh) and produces the same greedy plan as the dense path."""
 
 import asyncio
 
