@@ -1,11 +1,10 @@
 """In-tree BPE tokenizer: round-trips, grammar exactness, model-in-the-loop.
 
 This is the subword-vocab guarantee VERDICT r2 asked for (#4/#5) discharged
-with the self-contained trained vocab (``mcpx/models/bpe.py``): the
-SentencePiece fixture variant is blocked by the environment (no
-``sentencepiece`` package baked in), and the SP path stays gated in
-``models/tokenizer.py`` — the in-tree BPE exercises the exact same
-multi-byte token-DFA product machinery at serving-realistic vocab size.
+with the self-contained trained vocab (``mcpx/models/bpe.py``). The
+SentencePiece chain is separately covered by ``tests/test_tokenizer_sp.py``
+through the in-tree ModelProto codec (``models/sp_model.py``) — no
+``sentencepiece`` package needed.
 """
 
 from __future__ import annotations
