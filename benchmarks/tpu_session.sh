@@ -1,9 +1,32 @@
 #!/bin/bash
 # One TPU tunnel session, headline first: the axon tunnel admits one client
 # process at a time (a second blocks silently), so run everything in order
-# from a single shell. Usage: bash benchmarks/tpu_session.sh
+# from a single shell; each step is timeout-guarded, and artifacts are
+# written to a temp path and moved only on non-empty output — a mid-session
+# wedge never clobbers a previous session's good artifact.
+#
+#   1. bench.py            -> benchmarks/bench_tpu.json  (headline + quality)
+#   2. ladder.py           -> benchmarks/ladder_tpu.json (5 BASELINE configs)
+#   3. engine_probe sweeps -> benchmarks/probe_sweep_tpu.txt (p50 levers:
+#      budget/tick/minfree/spec/depth — pick the p50-optimal into bench.py)
+#
+# Usage: bash benchmarks/tpu_session.sh
 set -x
 cd "$(dirname "$0")/.."
-python bench.py 2>&1 | tail -3
+
+keep_if_nonempty() {  # $1 tmp, $2 dest
+  if [ -s "$1" ]; then mv "$1" "$2"; else rm -f "$1"; fi
+}
+
+timeout 3000 python bench.py 2> >(tail -5 >&2) | tail -1 > benchmarks/.bench_tpu.tmp
+keep_if_nonempty benchmarks/.bench_tpu.tmp benchmarks/bench_tpu.json
+cat benchmarks/bench_tpu.json 2>/dev/null
+
+timeout 3000 python benchmarks/ladder.py 2> >(tail -5 >&2) > benchmarks/.ladder_tpu.tmp
+keep_if_nonempty benchmarks/.ladder_tpu.tmp benchmarks/ladder_tpu.json
+cat benchmarks/ladder_tpu.json 2>/dev/null
+
 PROBE_SWEEP="budget=40;budget=32;budget=48;budget=40,tick=2;budget=40,minfree=1;budget=40,minfree=16;budget=40,spec=4;budget=40,depth=3" \
-  timeout 3500 python benchmarks/engine_probe.py 2>&1 | grep -E '^\{'
+  timeout 3500 python benchmarks/engine_probe.py 2>&1 | grep -E '^\{' > benchmarks/.probe_sweep_tpu.tmp
+keep_if_nonempty benchmarks/.probe_sweep_tpu.tmp benchmarks/probe_sweep_tpu.txt
+cat benchmarks/probe_sweep_tpu.txt 2>/dev/null
