@@ -654,22 +654,31 @@ class InferenceEngine:
     def _put(self, x, spec: P):
         return jax.device_put(x, self._named(spec))
 
+    def _put_many(self, *pairs):
+        """One ``jax.device_put`` for several (array, spec) pairs: a single
+        host dispatch instead of one per array. The admission and merge
+        paths each upload a handful of small row arrays; behind the tunnel
+        every separate dispatch costs ~7 ms of host time, which async
+        admission then serialises into the serving loop — batching the
+        uploads is a direct p50 lever."""
+        arrs = tuple(a for a, _ in pairs)
+        shardings = tuple(self._named(s) for _, s in pairs)
+        return jax.device_put(arrs, shardings)
+
     def _put_slab_state(self, slab: "_Slab") -> tuple:
         """Upload the slab's per-row arrays (cur, pos, st, emitted, done,
         budgets, page_table) in one device_put."""
         rs = self._row_spec(slab.B)
         rs2 = self._row_spec(slab.B, 1)
-        arrs = (
-            slab.cur,
-            slab.pos,
-            slab.st,
-            slab.emitted,
-            slab.done,
-            slab.budgets,
-            slab.page_table,
+        return self._put_many(
+            (slab.cur, rs),
+            (slab.pos, rs),
+            (slab.st, rs),
+            (slab.emitted, rs),
+            (slab.done, rs),
+            (slab.budgets, rs),
+            (slab.page_table, rs2),
         )
-        shardings = tuple(self._named(s) for s in (rs, rs, rs, rs, rs, rs, rs2))
-        return jax.device_put(arrs, shardings)
 
     def _dev_state(self, slab: "_Slab") -> tuple:
         """The device-resident slab state tuple, initialising it from the
@@ -809,15 +818,17 @@ class InferenceEngine:
         state = self._dev_state(slab)
         slab.dev = self._jit_merge(
             *state,
-            self._put(idx, rs),
-            self._put(np.full((B,), slab.pad_id, np.int32), rs),
-            self._put(np.zeros((B,), np.int32), rs),
-            self._put(np.zeros((B,), np.int32), rs),
-            self._put(np.zeros((B,), np.int32), rs),
-            self._put(np.ones((B,), bool), rs),
-            self._put(np.zeros((B,), np.int32), rs),
-            self._put(np.zeros((B, slab.page_table.shape[1]), np.int32), rs2),
-            self._put(np.full((B, slab.steps), slab.pad_id, np.int32), rs2),
+            *self._put_many(
+                (idx, rs),
+                (np.full((B,), slab.pad_id, np.int32), rs),
+                (np.zeros((B,), np.int32), rs),
+                (np.zeros((B,), np.int32), rs),
+                (np.zeros((B,), np.int32), rs),
+                (np.ones((B,), bool), rs),
+                (np.zeros((B,), np.int32), rs),
+                (np.zeros((B, slab.page_table.shape[1]), np.int32), rs2),
+                (np.full((B, slab.steps), slab.pad_id, np.int32), rs2),
+            ),
         )
 
     def prompt_capacity(self, max_new_tokens: int = 0, shared_prefix_len: int = 0) -> int:
@@ -1554,31 +1565,49 @@ class InferenceEngine:
         try:
             t0 = time.monotonic()
             dfa = self._dfa_for(slab.grammar or self.grammar)
+            # All of this admission's row arrays go up in ONE dispatch
+            # (budgets/active ride along for the _jit_admit call below).
+            rs, rs2 = self._row_spec(A), self._row_spec(A, 1)
             if prefix is not None:
+                tokens_d, lens_d, p_d, table_d, budgets_d, active_d = self._put_many(
+                    (tokens, rs2),
+                    (seq_lens, rs),
+                    (np.full((A,), P, np.int32), rs),
+                    (table, rs2),
+                    (budgets_np, rs),
+                    (active, rs),
+                )
                 # Suffix-only prefill: one chunked forward whose queries
                 # start at position P and attend the shared prefix pages +
                 # themselves (decode_chunk_paged's contract) — the prefix's
                 # FLOPs are paid once per cache entry, not per request.
                 last_logits, k_p, v_p = self._jit_suffix_prefill(
                     self._params,
-                    self._put(tokens, self._row_spec(A, 1)),
-                    self._put(seq_lens, self._row_spec(A)),
-                    self._put(np.full((A,), P, np.int32), self._row_spec(A)),
-                    self._put(table, self._row_spec(A, 1)),
+                    tokens_d,
+                    lens_d,
+                    p_d,
+                    table_d,
                     self._paged_kv["k"],
                     self._paged_kv["v"],
                 )
             else:
+                tokens_d, lens_d, table_d, budgets_d, active_d = self._put_many(
+                    (tokens, rs2),
+                    (seq_lens, rs),
+                    (table, rs2),
+                    (budgets_np, rs),
+                    (active, rs),
+                )
                 use_ring = self._ring_ok(T)
                 if use_ring:
                     self.metrics.ring_prefills.inc()
                 last_logits, k_p, v_p = self._jit_prefill(
                     self._params,
-                    self._put(tokens, self._row_spec(A, 1)),
-                    self._put(seq_lens, self._row_spec(A)),
+                    tokens_d,
+                    lens_d,
                     self._paged_kv["k"],
                     self._paged_kv["v"],
-                    self._put(table, self._row_spec(A, 1)),
+                    table_d,
                     T=T,
                     ring=use_ring,
                 )
@@ -1593,8 +1622,8 @@ class InferenceEngine:
             cur0, st0, done0 = self._jit_admit(
                 *dfa,
                 last_logits,
-                self._put(budgets_np, self._row_spec(A)),
-                self._put(active, self._row_spec(A)),
+                budgets_d,
+                active_d,
                 jax.random.PRNGKey((self._rng_base + self._seg_counter) & 0x7FFFFFFF),
                 temperature=slab.temperature,
                 constrained=slab.constrained,
@@ -1651,15 +1680,18 @@ class InferenceEngine:
         rs = self._row_spec(A)
         try:
             state = self._dev_state(slab)
+            # budgets_d/table_d from the admission upload are still live
+            # (prefill donates only the pools) — reuse, don't re-upload.
+            rows_d, pos_d = self._put_many((rows_arr, rs), (pos_arr, rs))
             slab.dev = self._jit_admit_merge(
                 *state,
-                self._put(rows_arr, rs),
+                rows_d,
                 cur0,
                 st0,
                 done0,
-                self._put(pos_arr, rs),
-                self._put(budgets_np, rs),
-                self._put(table, self._row_spec(A, 1)),
+                pos_d,
+                budgets_d,
+                table_d,
             )
         except BaseException as e:  # noqa: BLE001 - rows already assigned
             self._fail_rows(slab, e)
