@@ -45,6 +45,10 @@ class CorpusConfig:
     n_examples: int = 4096
     registry_size: int = 1000
     seed: int = 0
+    # Intent/shortlist draws default to ``seed`` but can differ: the
+    # registry is a deployment artifact (the model serves THIS registry),
+    # while fresh intent draws extend coverage without changing it.
+    intent_seed: "int | None" = None
     # Serving-parity knobs (bench.py's planner/engine geometry): 6-way
     # shortlist, 128-token prompt budget (the BPE prefill bucket).
     shortlist_top_k: int = 6
@@ -72,7 +76,7 @@ class Corpus:
 async def build_corpus(tokenizer, cfg: CorpusConfig | None = None) -> Corpus:
     """Generate the corpus with the serving stack's own components."""
     cfg = cfg or CorpusConfig()
-    rng = random.Random(cfg.seed)
+    rng = random.Random(cfg.seed if cfg.intent_seed is None else cfg.intent_seed)
     records = synth_registry(cfg.registry_size, seed=cfg.seed)
     registry = InMemoryRegistry()
     for r in records:

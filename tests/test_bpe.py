@@ -60,7 +60,6 @@ def test_bpe_out_of_distribution_compression_floor():
     byte tokenizer — its structural JSON/prompt merges are workload-
     independent even when the name merges are useless. Measured 2026-07:
     in-dist 6.8x prompt / 10.3x plan vs OOD 1.6x / 2.1x."""
-    import json
     import random
 
     from mcpx.models.tokenizer import ByteTokenizer

@@ -62,6 +62,25 @@ def test_corpus_rows_are_grammar_valid_and_serving_shaped(corpus):
         assert not m[corpus.seq_lens[i] - 1 :].any()
 
 
+def test_corpus_intent_seed_varies_intents_not_registry():
+    """``intent_seed`` draws fresh intents/shortlists for the SAME registry
+    (the registry is the deployment artifact the model serves; fine-tunes
+    extend intent coverage without changing it)."""
+    tok = BPETokenizer()
+    a = build_corpus_sync(tok, CorpusConfig(n_examples=12, registry_size=50, seed=3))
+    b = build_corpus_sync(
+        tok, CorpusConfig(n_examples=12, registry_size=50, seed=3, intent_seed=99)
+    )
+    assert a.intents != b.intents
+    # Same registry: every target's service names exist in seed-3's registry.
+    from mcpx.utils.synth import synth_registry
+
+    names = {r.name for r in synth_registry(50, seed=3)}
+    for text in b.texts:
+        plan = Plan.from_json(text)
+        assert all(n.service in names for n in plan.nodes)
+
+
 def test_train_reduces_loss_and_roundtrips_npz(tmp_path, corpus):
     tok = BPETokenizer()
     cfg = GemmaConfig.named("test", vocab_size=tok.vocab_size)
