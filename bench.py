@@ -192,56 +192,16 @@ async def _run_quality_trained(n_services: int, n_intents: int = 48) -> "dict | 
     ckpt = os.environ.get("MCPX_BENCH_QUALITY_CHECKPOINT", _TRAINED_CKPT)
     if not os.path.exists(ckpt):
         return None
-    import random
+    from mcpx.planner.evaluate import evaluate_planner
 
-    from mcpx.core.config import MCPXConfig
-    from mcpx.planner.quality import mean_quality, plan_quality
-    from mcpx.server.factory import build_control_plane
-    from mcpx.utils.synth import intent_for, synth_registry
-
-    cfg = MCPXConfig.from_dict(
-        {
-            "model": {
-                "size": "test",
-                "vocab": "bpe",
-                "max_seq_len": 2048,
-                "checkpoint_path": ckpt,
-            },
-            "engine": {
-                # The training corpus geometry (models/corpus.py).
-                "max_batch_size": 16,
-                "max_decode_len": 40,
-                "kv_page_size": 64,
-                "max_pages_per_seq": 4,
-                "temperature": 0.0,
-                "use_pallas": _on_tpu(),
-                "warmup_compile": False,
-            },
-            "planner": {"kind": "llm", "max_plan_retries": 0, "shortlist_top_k": 6},
-        }
+    # One shared eval protocol (CLI `mcpx eval-planner` uses the same):
+    # registry seed 0 = the trained registry; intents are fresh draws.
+    return await evaluate_planner(
+        checkpoint=ckpt,
+        registry_size=n_services,
+        n_intents=n_intents,
+        use_pallas=_on_tpu(),
     )
-    cp = build_control_plane(cfg)
-    records = synth_registry(n_services, seed=0)  # the trained registry
-    by_name = {r.name: r for r in records}
-    for rec in records:
-        await cp.registry.put(rec)
-    await cp.startup()
-    rng = random.Random(1234)  # fresh intents, disjoint from the corpus seed
-    rows = []
-    origins: dict[str, int] = {}
-    try:
-        for _ in range(n_intents):
-            intent = intent_for(records, rng, n_services=rng.randint(2, 4))
-            plan, _ms = await cp.plan(intent, use_cache=False)
-            origins[plan.origin or "unknown"] = origins.get(plan.origin or "unknown", 0) + 1
-            rows.append(plan_quality(plan, intent, by_name))
-    finally:
-        engine = getattr(cp.planner, "engine", None)
-        if engine is not None and engine.state == "ready":
-            await engine.aclose()
-    out = mean_quality(rows)
-    out["llm_share"] = origins.get("llm", 0) / max(1, sum(origins.values()))
-    return out
 
 
 async def _run(model_size: str, n_requests: int, concurrency: int, n_services: int) -> dict:

@@ -113,6 +113,28 @@ def cmd_train_planner(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_eval_planner(args: argparse.Namespace) -> int:
+    """Serve a planner checkpoint through the real stack (engine +
+    grammar-constrained decode + retrieval shortlist) and print its
+    plan-quality metrics as one JSON line. Protocol shared with bench.py
+    via ``planner/evaluate.py``."""
+    from mcpx.planner.evaluate import evaluate_planner
+
+    out = asyncio.run(
+        evaluate_planner(
+            checkpoint=args.checkpoint,
+            size=args.size,
+            vocab=args.vocab,
+            registry_size=args.registry,
+            registry_seed=args.registry_seed,
+            n_intents=args.intents,
+            seed=args.seed,
+        )
+    )
+    print(json.dumps({k: round(v, 4) if isinstance(v, float) else v for k, v in out.items()}))
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="mcpx")
     parser.add_argument("--config", help="JSON config file")
@@ -147,6 +169,18 @@ def main(argv: list[str] | None = None) -> int:
     p_train.add_argument("--lr", type=float, default=3e-3)
     p_train.add_argument("--seed", type=int, default=0)
     p_train.set_defaults(func=cmd_train_planner)
+
+    p_eval = sub.add_parser(
+        "eval-planner", help="score a planner checkpoint's plan quality"
+    )
+    p_eval.add_argument("--checkpoint", default="mcpx/models/checkpoints/planner_test_bpe.npz")
+    p_eval.add_argument("--size", default="test")
+    p_eval.add_argument("--vocab", default="bpe")
+    p_eval.add_argument("--registry", type=int, default=1000)
+    p_eval.add_argument("--registry-seed", type=int, default=0)
+    p_eval.add_argument("--intents", type=int, default=48)
+    p_eval.add_argument("--seed", type=int, default=1234)
+    p_eval.set_defaults(func=cmd_eval_planner)
 
     args = parser.parse_args(argv)
     return args.func(args)

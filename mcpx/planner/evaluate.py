@@ -1,0 +1,87 @@
+"""Offline planner evaluation: serve a checkpoint, score plan quality.
+
+One protocol shared by ``bench.py`` (``plan_quality_trained``), the
+``mcpx eval-planner`` CLI, and tests — the eval geometry (decode budget,
+shortlist width, registry seed) must not drift between them, or they
+silently measure different things."""
+
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+
+async def evaluate_planner(
+    *,
+    checkpoint: str,
+    size: str = "test",
+    vocab: str = "bpe",
+    registry_size: int = 1000,
+    registry_seed: int = 0,
+    n_intents: int = 48,
+    seed: int = 1234,
+    shortlist_top_k: int = 6,
+    use_pallas: Optional[bool] = None,
+) -> dict:
+    """Serve ``checkpoint`` through the real control plane (engine +
+    retrieval shortlist + grammar-constrained decode) against a synthetic
+    registry and return mean plan-quality + ``llm_share``. ``use_pallas``
+    defaults to whether a non-CPU backend is live (a pinned 2b on a CPU
+    host must not lower Mosaic TPU kernels)."""
+    import jax
+
+    from mcpx.core.config import MCPXConfig
+    from mcpx.planner.quality import mean_quality, plan_quality
+    from mcpx.server.factory import build_control_plane
+    from mcpx.utils.synth import intent_for, synth_registry
+
+    if use_pallas is None:
+        use_pallas = jax.default_backend() not in ("cpu",)
+    cfg = MCPXConfig.from_dict(
+        {
+            "model": {
+                "size": size,
+                "vocab": vocab,
+                "max_seq_len": 2048,
+                "checkpoint_path": checkpoint,
+            },
+            "engine": {
+                # The training corpus geometry (models/corpus.py).
+                "max_batch_size": 16,
+                "max_decode_len": 40,
+                "kv_page_size": 64,
+                "max_pages_per_seq": 4,
+                "temperature": 0.0,
+                "use_pallas": use_pallas,
+                "warmup_compile": False,
+            },
+            "planner": {
+                "kind": "llm",
+                "max_plan_retries": 0,
+                "shortlist_top_k": shortlist_top_k,
+            },
+        }
+    )
+    cp = build_control_plane(cfg)
+    records = synth_registry(registry_size, seed=registry_seed)
+    by_name = {r.name: r for r in records}
+    for rec in records:
+        await cp.registry.put(rec)
+    await cp.startup()
+    rng = random.Random(seed)
+    rows: list[dict] = []
+    origins: dict[str, int] = {}
+    try:
+        for _ in range(n_intents):
+            intent = intent_for(records, rng, n_services=rng.randint(2, 4))
+            plan, _ms = await cp.plan(intent, use_cache=False)
+            origin = plan.origin or "unknown"
+            origins[origin] = origins.get(origin, 0) + 1
+            rows.append(plan_quality(plan, intent, by_name))
+    finally:
+        engine = getattr(cp.planner, "engine", None)
+        if engine is not None and engine.state == "ready":
+            await engine.aclose()
+    out = mean_quality(rows)
+    out["llm_share"] = origins.get("llm", 0) / max(1, sum(origins.values()))
+    return out
