@@ -100,3 +100,86 @@ def test_layer_count_mismatch_rejected():
     with pytest.raises(EngineError, match="2 layers"):
         convert_flax_gemma(tree, cfg)
     assert infer_n_layers({f"transformer/layer_{i}/x": 0 for i in range(5)}) == 5
+
+
+def test_real_checkpoint_chain_convert_save_serve_sp_vocab(tmp_path):
+    """The full real-checkpoint rehearsal, minus only the real weights:
+    published Flax layout -> convert -> single-file .npz -> engine restore
+    (sharded onto the serving mesh) with a SentencePiece vocab (in-tree
+    codec) -> grammar-constrained LLM plan through the planner. This is the
+    exact chain a user with downloaded Gemma weights runs (convert.py +
+    models/sp_model.py), at fixture scale."""
+    import asyncio
+
+    from mcpx.core.config import MCPXConfig
+    from mcpx.engine.engine import InferenceEngine
+    from mcpx.models.sp_model import tiny_model
+    from mcpx.models.tokenizer import SentencePieceTokenizer
+    from mcpx.models.train import save_npz
+    from mcpx.planner.base import PlanContext
+    from mcpx.planner.llm import LLMPlanner
+    from mcpx.registry.base import ServiceRecord
+    from mcpx.registry.memory import InMemoryRegistry
+
+    sp_path = str(tmp_path / "tiny.model")
+    tiny_model().save(sp_path)
+    tok = SentencePieceTokenizer(sp_path)
+
+    # "test"-preset dims at the SP fixture's vocab — the size the engine
+    # will instantiate for model.size="test" + this tokenizer.
+    cfg = GemmaConfig.named("test", vocab_size=tok.vocab_size)
+    tree = _published_tree(cfg, fused_qkv=False, v_src=tok.n_real)
+    params = convert_flax_gemma(tree, cfg)
+    ckpt = str(tmp_path / "converted.npz")
+    save_npz(ckpt, params)
+
+    mcfg = MCPXConfig.from_dict(
+        {
+            "model": {
+                "size": "test",
+                "max_seq_len": 256,
+                "vocab": f"sp:{sp_path}",
+                "checkpoint_path": ckpt,
+            },
+            "engine": {
+                "use_pallas": False,
+                "max_batch_size": 2,
+                "max_decode_len": 48,
+                "kv_page_size": 16,
+                "max_pages_per_seq": 16,
+                "temperature": 0.0,
+            },
+            "planner": {"kind": "llm", "max_plan_retries": 0},
+        }
+    )
+
+    async def go():
+        reg = InMemoryRegistry()
+        await reg.put(
+            ServiceRecord(
+                name="auth-fetch-0001",
+                endpoint="http://svc/auth",
+                output_schema={"user": "str"},
+            )
+        )
+        await reg.put(
+            ServiceRecord(
+                name="billing-score-0002",
+                endpoint="http://svc/billing",
+                input_schema={"user": "str"},
+            )
+        )
+        eng = InferenceEngine(mcfg)
+        planner = LLMPlanner(eng, mcfg.planner)
+        try:
+            plan = await planner.plan(
+                "please fetch then score", PlanContext(registry=reg)
+            )
+            assert plan.origin == "llm", plan.explanation
+            assert plan.nodes
+            for n in plan.nodes:
+                assert n.service in ("auth-fetch-0001", "billing-score-0002")
+        finally:
+            await eng.aclose()
+
+    asyncio.run(go())
