@@ -87,11 +87,14 @@ def cmd_train_planner(args: argparse.Namespace) -> int:
     from mcpx.models.corpus import CorpusConfig, build_corpus_sync
     from mcpx.models.gemma.config import GemmaConfig
     from mcpx.models.tokenizer import make_tokenizer
-    from mcpx.models.train import TrainConfig, save_npz, train
+    from mcpx.models.train import TrainConfig, load_npz, save_npz, train
 
     tok = make_tokenizer(args.vocab)
     ccfg = CorpusConfig(
-        n_examples=args.examples, registry_size=args.registry, seed=args.seed
+        n_examples=args.examples,
+        registry_size=args.registry,
+        seed=args.seed,
+        intent_seed=args.intent_seed,
     )
     t0 = time.time()
     corpus = build_corpus_sync(tok, ccfg)
@@ -103,9 +106,17 @@ def cmd_train_planner(args: argparse.Namespace) -> int:
     tcfg = TrainConfig(
         steps=args.steps, batch_size=args.batch, lr=args.lr, seed=args.seed
     )
+    init = None
+    if args.init:
+        import jax
+        import jax.numpy as jnp
+
+        # Warm start (fine-tune): e.g. extend intent coverage over the same
+        # registry with --intent-seed, at a lower --lr.
+        init = jax.tree.map(lambda a: a.astype(jnp.float32), load_npz(args.init))
     t0 = time.time()
     params, report = train(
-        cfg, corpus, tcfg, log_fn=lambda m: print(m, flush=True)
+        cfg, corpus, tcfg, init=init, log_fn=lambda m: print(m, flush=True)
     )
     print(f"trained {args.steps} steps in {time.time() - t0:.0f}s: {report}")
     save_npz(args.out, params)
@@ -168,6 +179,10 @@ def main(argv: list[str] | None = None) -> int:
     p_train.add_argument("--batch", type=int, default=24)
     p_train.add_argument("--lr", type=float, default=3e-3)
     p_train.add_argument("--seed", type=int, default=0)
+    p_train.add_argument("--intent-seed", type=int, default=None,
+                         help="fresh intent draws over the same registry")
+    p_train.add_argument("--init", default="",
+                         help="warm-start from an existing .npz checkpoint")
     p_train.set_defaults(func=cmd_train_planner)
 
     p_eval = sub.add_parser(
