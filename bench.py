@@ -180,7 +180,7 @@ _TRAINED_CKPT = os.path.join(
 )
 
 
-async def _run_quality_trained(n_services: int, n_intents: int = 48) -> "dict | None":
+async def _run_quality_trained(n_intents: int = 48) -> "dict | None":
     """Serve the committed TRAINED planner checkpoint (tiny model, BPE
     vocab) against the same registry scale and score plan quality — the
     semantic-capability number the headline run (random 2B-architecture
@@ -195,13 +195,22 @@ async def _run_quality_trained(n_services: int, n_intents: int = 48) -> "dict | 
     from mcpx.planner.evaluate import evaluate_planner
 
     # One shared eval protocol (CLI `mcpx eval-planner` uses the same):
-    # registry seed 0 = the trained registry; intents are fresh draws.
-    return await evaluate_planner(
+    # registry size 1000 / seed 0 = the checkpoint's documented protocol
+    # (ladder config6) — pinned regardless of MCPX_BENCH_SERVICES so an
+    # off-default headline run cannot silently report an off-protocol
+    # quality number under the same key (ADVICE r4). The protocol params
+    # are echoed in the result so any override is visible.
+    registry_size, registry_seed = 1000, 0
+    out = await evaluate_planner(
         checkpoint=ckpt,
-        registry_size=n_services,
+        registry_size=registry_size,
+        registry_seed=registry_seed,
         n_intents=n_intents,
         use_pallas=_on_tpu(),
     )
+    out["registry_size"] = registry_size
+    out["registry_seed"] = registry_seed
+    return out
 
 
 async def _run(model_size: str, n_requests: int, concurrency: int, n_services: int) -> dict:
@@ -471,7 +480,7 @@ def main() -> None:
     q_timeout = float(os.environ.get("MCPX_BENCH_QUALITY_TIMEOUT_S", "900"))
 
     async def _quality_bounded():
-        return await asyncio.wait_for(_run_quality_trained(n_services), q_timeout)
+        return await asyncio.wait_for(_run_quality_trained(), q_timeout)
 
     try:
         quality_trained = asyncio.run(_quality_bounded())

@@ -18,7 +18,9 @@ keep_if_nonempty() {  # $1 tmp, $2 dest
   if [ -s "$1" ]; then mv "$1" "$2"; else rm -f "$1"; fi
 }
 
-timeout 3000 python bench.py 2> >(tail -5 >&2) | tail -1 > benchmarks/.bench_tpu.tmp
+# grep for the JSON line so a non-JSON diagnostic on stdout can never
+# replace a previous session's good artifact (ADVICE r4).
+timeout 3000 python bench.py 2> >(tail -5 >&2) | grep -E '^\{' | tail -1 > benchmarks/.bench_tpu.tmp
 keep_if_nonempty benchmarks/.bench_tpu.tmp benchmarks/bench_tpu.json
 cat benchmarks/bench_tpu.json 2>/dev/null
 

@@ -115,27 +115,49 @@ def plan_quality(
         coherence = ok / len(edges)
     else:
         # Edge-less plans are legal (parallel roots feeding from the
-        # payload); coherence asserts nothing about them.
+        # payload); coherence asserts nothing about them. They score 1.0
+        # per-plan but are EXCLUDED from the aggregate coherence in
+        # mean_quality (via n_edges), so degenerate single-node output
+        # cannot buoy the headline score (ADVICE r4).
         coherence = 1.0
     return {
         "coverage": coverage,
         "relevance": relevance,
         "coherence": coherence,
         "score": (coverage + relevance + coherence) / 3.0,
+        "n_edges": len(edges),
     }
 
 
 def mean_quality(
     scored: Iterable[dict[str, float]],
 ) -> dict[str, float]:
+    """Aggregate per-plan scores. Coherence is averaged only over plans
+    that HAVE edges (``n_with_edges``) — an edge-less plan asserts nothing
+    about data flow, so it must not contribute free 1.0s to the aggregate
+    (ADVICE r4). The aggregate ``score`` is recomputed from the aggregate
+    components so the same exclusion reaches the headline number. Rows
+    from older callers without ``n_edges`` conservatively count as edged."""
     rows = list(scored)
     if not rows:
-        return {"coverage": 0.0, "relevance": 0.0, "coherence": 0.0, "score": 0.0, "n": 0}
+        return {
+            "coverage": 0.0, "relevance": 0.0, "coherence": 0.0,
+            "score": 0.0, "n": 0, "n_with_edges": 0,
+        }
     out = {
         k: sum(r[k] for r in rows) / len(rows)
-        for k in ("coverage", "relevance", "coherence", "score")
+        for k in ("coverage", "relevance")
     }
+    edged = [r for r in rows if r.get("n_edges", 1) > 0]
+    if edged:
+        out["coherence"] = sum(r["coherence"] for r in edged) / len(edged)
+    else:
+        # No plan had edges: coherence is unasserted, not perfect. Report
+        # 0.0 so all-single-node output reads as the degenerate case it is.
+        out["coherence"] = 0.0
+    out["score"] = (out["coverage"] + out["relevance"] + out["coherence"]) / 3.0
     out["n"] = len(rows)
+    out["n_with_edges"] = len(edged)
     return out
 
 
