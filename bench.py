@@ -182,7 +182,8 @@ _TRAINED_CKPT = os.path.join(
 
 async def _run_quality_trained(n_intents: int = 48) -> "dict | None":
     """Serve the committed TRAINED planner checkpoint (tiny model, BPE
-    vocab) against the same registry scale and score plan quality — the
+    vocab) against its pinned eval protocol (registry size 1000, seed 0 —
+    independent of MCPX_BENCH_SERVICES) and score plan quality — the
     semantic-capability number the headline run (random 2B-architecture
     weights) cannot produce (VERDICT r3 next #3). None when no checkpoint
     artifact is committed. Caveat: the checkpoint is trained on this

@@ -18,10 +18,18 @@ keep_if_nonempty() {  # $1 tmp, $2 dest
   if [ -s "$1" ]; then mv "$1" "$2"; else rm -f "$1"; fi
 }
 
-# grep for the JSON line so a non-JSON diagnostic on stdout can never
-# replace a previous session's good artifact (ADVICE r4).
+keep_if_json() {  # $1 tmp, $2 dest — only complete JSON may replace a good artifact
+  if [ -s "$1" ] && python -m json.tool "$1" > /dev/null 2>&1; then
+    mv "$1" "$2"
+  else
+    rm -f "$1"
+  fi
+}
+
+# grep + json.tool so neither a non-JSON diagnostic nor a timeout-truncated
+# fragment can replace a previous session's good artifact (ADVICE r4).
 timeout 3000 python bench.py 2> >(tail -5 >&2) | grep -E '^\{' | tail -1 > benchmarks/.bench_tpu.tmp
-keep_if_nonempty benchmarks/.bench_tpu.tmp benchmarks/bench_tpu.json
+keep_if_json benchmarks/.bench_tpu.tmp benchmarks/bench_tpu.json
 cat benchmarks/bench_tpu.json 2>/dev/null
 
 timeout 3000 python benchmarks/ladder.py 2> >(tail -5 >&2) > benchmarks/.ladder_tpu.tmp

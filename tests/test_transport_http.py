@@ -1,0 +1,279 @@
+"""Real-socket tests for ``AioHttpTransport`` — the one path that talks to
+actual microservices over TCP (reference ``control_plane.py:109,123``).
+
+Every other test drives ``local://`` fakes; this module boots genuine
+aiohttp servers on 127.0.0.1 and asserts the transport's contract where it
+actually matters: HTTP status → ``TransportError.status`` mapping, client
+timeout → ``timeout=True`` flagging, connection-refused handling, non-JSON
+body rejection, and pooled keep-alive connection reuse. The final test
+drives ``/plan_and_execute`` end to end through a ``RouterTransport``
+mixing ``http://`` and ``local://`` nodes in one plan (VERDICT r4 next #4).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import socket
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from mcpx.core.config import MCPXConfig
+from mcpx.orchestrator.transport import (
+    AioHttpTransport,
+    LocalTransport,
+    RouterTransport,
+    TransportError,
+)
+from mcpx.registry import ServiceRecord
+from mcpx.server.app import build_app
+from mcpx.server.factory import build_control_plane
+
+
+class MicroService:
+    """A real aiohttp microservice on 127.0.0.1 with scriptable routes.
+
+    Tracks the client socket's peer port per request so tests can assert
+    keep-alive connection reuse (same peer port ⇒ same pooled connection).
+    """
+
+    def __init__(self) -> None:
+        self.requests: list[dict] = []
+        self.peer_ports: list[int] = []
+        self._runner: web.AppRunner | None = None
+        self.port: int | None = None
+
+    async def start(self) -> str:
+        app = web.Application()
+        app.router.add_post("/ok", self._ok)
+        app.router.add_post("/err500", self._err500)
+        app.router.add_post("/slow", self._slow)
+        app.router.add_post("/notjson", self._notjson)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        return f"http://127.0.0.1:{self.port}"
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    async def _record(self, request: web.Request) -> dict:
+        body = await request.json()
+        self.requests.append(body)
+        peer = request.transport.get_extra_info("peername")
+        if peer:
+            self.peer_ports.append(peer[1])
+        return body
+
+    async def _ok(self, request: web.Request) -> web.Response:
+        body = await self._record(request)
+        return web.json_response({"service": "real", "echo": body})
+
+    async def _err500(self, request: web.Request) -> web.Response:
+        await self._record(request)
+        return web.json_response({"detail": "exploded"}, status=500)
+
+    async def _slow(self, request: web.Request) -> web.Response:
+        await self._record(request)
+        await asyncio.sleep(5.0)
+        return web.json_response({"late": True})
+
+    async def _notjson(self, request: web.Request) -> web.Response:
+        await self._record(request)
+        return web.Response(text="<html>not json</html>", content_type="text/html")
+
+
+def _refused_port() -> int:
+    """A port that was just bound and closed — connecting to it refuses."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_post_success_and_status_mapping():
+    async def go():
+        svc = MicroService()
+        base = await svc.start()
+        transport = AioHttpTransport()
+        try:
+            out = await transport.post(f"{base}/ok", {"x": 1}, 5.0)
+            assert out == {"service": "real", "echo": {"x": 1}}
+
+            with pytest.raises(TransportError) as ei:
+                await transport.post(f"{base}/err500", {}, 5.0)
+            assert ei.value.status == 500
+            assert not ei.value.timeout
+            assert "exploded" in str(ei.value)
+
+            with pytest.raises(TransportError) as ei:
+                await transport.post(f"{base}/notjson", {}, 5.0)
+            assert "non-JSON" in str(ei.value)
+        finally:
+            await transport.close()
+            await svc.stop()
+
+    asyncio.run(go())
+
+
+def test_post_timeout_sets_timeout_flag():
+    async def go():
+        svc = MicroService()
+        base = await svc.start()
+        transport = AioHttpTransport()
+        try:
+            with pytest.raises(TransportError) as ei:
+                await transport.post(f"{base}/slow", {}, 0.2)
+            assert ei.value.timeout
+        finally:
+            await transport.close()
+            await svc.stop()
+
+    asyncio.run(go())
+
+
+def test_connection_refused_maps_to_transport_error():
+    async def go():
+        transport = AioHttpTransport()
+        url = f"http://127.0.0.1:{_refused_port()}/ok"
+        try:
+            with pytest.raises(TransportError) as ei:
+                await transport.post(url, {}, 2.0)
+            assert not ei.value.timeout
+            assert ei.value.status == 0
+        finally:
+            await transport.close()
+
+    asyncio.run(go())
+
+
+def test_pooled_session_reuses_connection():
+    """Sequential posts ride ONE lazily-created session and, via keep-alive,
+    one TCP connection — the pooling the transport exists for."""
+
+    async def go():
+        svc = MicroService()
+        base = await svc.start()
+        transport = AioHttpTransport()
+        assert transport._session is None  # lazy: no socket before first post
+        try:
+            for i in range(4):
+                await transport.post(f"{base}/ok", {"i": i}, 5.0)
+            session = transport._session
+            assert session is not None
+            await transport.post(f"{base}/ok", {"i": 99}, 5.0)
+            assert transport._session is session  # one session for the life of the transport
+            assert len(set(svc.peer_ports)) == 1, (
+                f"expected one kept-alive connection, saw peer ports {svc.peer_ports}"
+            )
+        finally:
+            await transport.close()
+            await svc.stop()
+
+    asyncio.run(go())
+
+
+def test_plan_and_execute_mixes_http_and_local_nodes():
+    """End to end over real sockets: the planner resolves one service to a
+    genuine ``http://127.0.0.1`` endpoint and one to ``local://``; the
+    executor wires the HTTP node's output into the local node's input
+    through a ``RouterTransport``."""
+
+    async def go():
+        svc = MicroService()
+        base = await svc.start()
+
+        local = LocalTransport()
+        seen_local: list[dict] = []
+
+        async def summarize(payload: dict) -> dict:
+            seen_local.append(payload)
+            return {"summary": "short"}
+
+        local_url = local.register("summarize", summarize)
+        cp = build_control_plane(MCPXConfig(), transport=RouterTransport(local=local))
+        await cp.registry.put(
+            ServiceRecord(
+                name="fetch",
+                endpoint=f"{base}/ok",
+                description="fetch remote documents by query",
+                input_schema={"query": "str"},
+                output_schema={"echo": "dict"},
+            )
+        )
+        await cp.registry.put(
+            ServiceRecord(
+                name="summarize",
+                endpoint=local_url,
+                description="summarize a fetched document",
+                input_schema={"echo": "dict"},
+                output_schema={"summary": "str"},
+            )
+        )
+
+        client = TestClient(TestServer(build_app(cp)))
+        await client.start_server()
+        try:
+            r = await client.post(
+                "/plan_and_execute",
+                json={"intent": "fetch remote documents and summarize", "payload": {"query": "q"}},
+            )
+            assert r.status == 200
+            body = await r.json()
+            assert body["status"] == "ok"
+            assert body["results"]["summarize"] == {"summary": "short"}
+            assert svc.requests, "the http:// node never reached the real server"
+            assert seen_local, "the local:// node never ran"
+        finally:
+            await client.close()
+            await svc.stop()
+
+    asyncio.run(go())
+
+
+def test_executor_timeout_and_fallback_over_real_sockets():
+    """A slow real endpoint trips the node timeout (flagged as such in the
+    trace) and the executor recovers through the node's ordered fallback —
+    the retry/fallback state machine against genuine TCP semantics, which
+    the reference's own fallback never achieved (bug B2)."""
+
+    async def go():
+        svc = MicroService()
+        base = await svc.start()
+        transport = RouterTransport(local=LocalTransport())
+        cp = build_control_plane(MCPXConfig(), transport=transport)
+
+        graph = {
+            "nodes": [
+                {
+                    "name": "flaky",
+                    "endpoint": f"{base}/slow",
+                    "timeout_s": 0.2,
+                    "retries": 0,
+                    "fallbacks": [f"{base}/ok"],
+                    "inputs": {"query": "query"},
+                }
+            ],
+            "edges": [],
+        }
+        client = TestClient(TestServer(build_app(cp)))
+        await client.start_server()
+        try:
+            r = await client.post("/execute", json={"graph": graph, "payload": {"query": "q"}})
+            assert r.status == 200
+            body = await r.json()
+            assert body["status"] == "ok"
+            assert body["results"]["flaky"]["service"] == "real"
+            attempts = body["trace"]["nodes"][0]["attempts"]
+            assert attempts[0]["status"] == "timeout"
+            assert attempts[-1]["status"] == "ok"
+        finally:
+            await client.close()
+            await svc.stop()
+
+    asyncio.run(go())
