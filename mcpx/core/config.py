@@ -112,6 +112,16 @@ class EngineConfig:
     # structure like '{"steps":[') need no sampling, only KV population, so
     # this is exact, not probabilistic. <=1 disables (single-token loop).
     speculate_k: int = 8
+    # Draft speculation for the chunk positions grammar fast-forward can't
+    # force (multi-successor trie states — name branch points, key lists —
+    # and free strings on fallback grammars): "prompt" proposes the
+    # continuation after the last (prev, cur) bigram match in the row's own
+    # prompt (plans echo shortlist names and schema keys verbatim), verified
+    # per-position against masked-greedy argmax over the grammar's compact
+    # columns — exact under greedy decode (temperature 0), auto-disabled
+    # otherwise (probabilistic acceptance is not implemented). "off" keeps
+    # forced-token fast-forward only. VERDICT r4 next #6.
+    draft_mode: str = "prompt"
     # Batch-size buckets requests are padded up to. Few buckets = few XLA
     # compiles (each (B, T) pair is one prefill executable, each B one decode
     # executable); padding rows are nearly free on TPU where decode is
@@ -154,6 +164,11 @@ class RetrievalConfig:
     # both inflates /plan latency and fragments engine batching.
     compute: str = "auto"
     device_threshold: int = 65536
+    # "residual" (default): coverage-greedy shortlist — greedily pick
+    # services covering still-unmatched intent words, fill the rest by
+    # similarity; fixes the multi-clause coverage ceiling (r4: 0.74 oracle
+    # coverage with plain top-k). "topk": plain embedding similarity.
+    shortlist_mode: str = "residual"
     # Refresh the HBM table when the registry version changes.
     auto_refresh: bool = True
     # Optional .npz snapshot to load at startup (rebuildable from registry).
@@ -316,6 +331,15 @@ class MCPXConfig:
             problems.append("telemetry.ewma_alpha must be in (0, 1]")
         if self.retrieval.top_k < 1:
             problems.append("retrieval.top_k must be >= 1")
+        if self.engine.draft_mode not in ("prompt", "off"):
+            problems.append(
+                f"engine.draft_mode '{self.engine.draft_mode}' not in prompt|off"
+            )
+        if self.retrieval.shortlist_mode not in ("residual", "topk"):
+            problems.append(
+                f"retrieval.shortlist_mode '{self.retrieval.shortlist_mode}' "
+                "not in residual|topk"
+            )
         if problems:
             raise ConfigError("; ".join(problems))
 

@@ -81,6 +81,14 @@ class ServiceRecord:
         outs = ", ".join(f"{k}:{v}" for k, v in sorted(self.output_schema.items()))
         return f"{self.name} | {self.description} | in({ins}) out({outs}) | {' '.join(self.tags)}"
 
+    def topic_text(self) -> str:
+        """WHAT the service is about (name, tags, description) — excludes
+        schema keys, which are interface plumbing shared across unrelated
+        services and drown topical words in document-frequency statistics
+        (retrieval's coverage-greedy shortlist indexes this, not
+        ``schema_text``)."""
+        return f"{self.name} | {self.description} | {' '.join(self.tags)}"
+
 
 @runtime_checkable
 class RegistryBackend(Protocol):
