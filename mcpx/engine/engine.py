@@ -144,7 +144,9 @@ class _Slab:
     page 0, which no live sequence ever reads).
     """
 
-    def __init__(self, B: int, steps: int, pmax: int, pad_id: int) -> None:
+    def __init__(
+        self, B: int, steps: int, pmax: int, pad_id: int, prompt_cap: int = 0
+    ) -> None:
         self.B = B
         self.steps = steps
         self.pad_id = pad_id
@@ -164,6 +166,16 @@ class _Slab:
         self.budgets = np.zeros((B,), np.int32)
         self.out_buf = np.full((B, steps), pad_id, np.int32)
         self.page_table = np.zeros((B, pmax), np.int32)
+        # Prompt-lookup draft state: each row's prompt (suffix) tokens stay
+        # device-resident so the decode segment can propose continuations
+        # after a bigram match (EngineConfig.draft_mode). ``prev`` is the
+        # token before ``cur`` — the other half of the match bigram. Host
+        # mirrors hold clear values only (authoritative copies live in
+        # slab.dev, written by the admit merge, like cur/st).
+        self.prompt_cap = max(1, prompt_cap)
+        self.prompt_toks = np.full((B, self.prompt_cap), pad_id, np.int32)
+        self.prompt_lens = np.zeros((B,), np.int32)
+        self.prev = np.full((B,), pad_id, np.int32)
         self.queue_ms = np.zeros((B,), np.float64)
         self.prefill_ms = np.zeros((B,), np.float64)
         self.t_decode0 = np.zeros((B,), np.float64)
@@ -204,6 +216,9 @@ class _Slab:
         self.st[i] = 0
         self.emitted[i] = 0
         self.budgets[i] = 0
+        self.prompt_toks[i, :] = self.pad_id
+        self.prompt_lens[i] = 0
+        self.prev[i] = self.pad_id
         self.gen[i] += 1
         self.page_table[i, :] = 0
         if self.prefix[i] is not None:
@@ -507,7 +522,7 @@ class InferenceEngine:
         # copy is [B, steps] int32, noise next to the KV pools.
         self._jit_segment = jax.jit(
             self._segment_impl,
-            static_argnames=("iters", "chunk", "temperature", "constrained"),
+            static_argnames=("iters", "chunk", "temperature", "constrained", "draft"),
             donate_argnames=("paged_k", "paged_v"),
         )
         # Merges donate NOTHING: their inputs are the newest segment's
@@ -515,11 +530,17 @@ class InferenceEngine:
         # readable.
         self._jit_merge = jax.jit(self._merge_impl)
         self._jit_admit_merge = jax.jit(self._admit_merge_impl)
+        capacity = ecfg.max_pages_per_seq * ecfg.kv_page_size
+        fitting = [b for b in self._prefill_buckets if b <= capacity]
         self._slab = _Slab(
             ecfg.max_batch_size,
             ecfg.max_decode_len,
             ecfg.max_pages_per_seq,
             self.tokenizer.pad_id,
+            # Draft-lookup prompt buffer: sized to the largest admittable
+            # prefill bucket (suffix tokens only; the shared-prefix header
+            # is fixed boilerplate with nothing worth drafting from).
+            prompt_cap=max(fitting) if fitting else 1,
         )
         if ecfg.warmup_compile:
             self._warmup()
@@ -616,6 +637,7 @@ class InferenceEngine:
             # Admit-merge executable for this cohort bucket (all-dropped
             # scatter: rows filled with B = padding, a semantic no-op).
             rs_a = self._row_spec(A)
+            rs_a2 = self._row_spec(A, 1)
             self._jit_admit_merge(
                 *self._dev_state(self._slab),
                 self._put(np.full((A,), self._slab.B, np.int32), rs_a),
@@ -623,25 +645,38 @@ class InferenceEngine:
                 self._put(np.zeros((A,), np.int32), rs_a),
                 self._put(np.zeros((A,), np.int32), rs_a),
                 self._put(
-                    np.zeros((A, ecfg.max_pages_per_seq), np.int32),
-                    self._row_spec(A, 1),
+                    np.zeros((A, ecfg.max_pages_per_seq), np.int32), rs_a2
                 ),
+                self._put(
+                    np.full((A, self._slab.prompt_cap), tok.pad_id, np.int32),
+                    rs_a2,
+                ),
+                self._put(np.zeros((A,), np.int32), rs_a),
+                self._put(np.full((A,), tok.pad_id, np.int32), rs_a),
             )
         slab = self._slab
         chunk = self._spec_chunk(True)
         iters = max(1, ecfg.decode_steps_per_tick)
+        rs_b = self._row_spec(slab.B)
+        rs_b2 = self._row_spec(slab.B, 1)
         out = self._jit_segment(
             self._params,
             *dfa,
             *self._put_slab_state(slab),
             self._paged_kv["k"],
             self._paged_kv["v"],
-            self._put(slab.out_buf, self._row_spec(slab.B, 1)),
+            *self._put_many(
+                (slab.out_buf, rs_b2),
+                (slab.prompt_toks, rs_b2),
+                (slab.prompt_lens, rs_b),
+                (slab.prev, rs_b),
+            ),
             key,
             iters=iters,
             chunk=chunk,
             temperature=ecfg.temperature,
             constrained=True,
+            draft=ecfg.draft_mode == "prompt",
         )
         self._paged_kv = {"k": out[5], "v": out[6]}
         # Compile the admission/retirement merge scatter too (row 0 is free,
@@ -681,11 +716,19 @@ class InferenceEngine:
         )
 
     def _dev_state(self, slab: "_Slab") -> tuple:
-        """The device-resident slab state tuple, initialising it from the
-        host arrays (startup / after a failure reset) when absent."""
+        """The device-resident slab state tuple — indices 0..7 are (cur,
+        pos, st, emitted, done, budgets, page_table, out_buf); 8..10 the
+        draft-lookup state (prompt_toks, prompt_lens, prev). Initialised
+        from the host arrays (startup / after a failure reset) when
+        absent."""
         if slab.dev is None:
-            slab.dev = self._put_slab_state(slab) + (
-                self._put(slab.out_buf, self._row_spec(slab.B, 1)),
+            rs = self._row_spec(slab.B)
+            rs2 = self._row_spec(slab.B, 1)
+            slab.dev = self._put_slab_state(slab) + self._put_many(
+                (slab.out_buf, rs2),
+                (slab.prompt_toks, rs2),
+                (slab.prompt_lens, rs),
+                (slab.prev, rs),
             )
         return slab.dev
 
@@ -699,6 +742,9 @@ class InferenceEngine:
         budgets,
         pt,
         buf,
+        ptoks,
+        plens,
+        prev,
         rows,
         cur_v,
         pos_v,
@@ -708,6 +754,9 @@ class InferenceEngine:
         budgets_v,
         pt_v,
         buf_v,
+        ptoks_v,
+        plens_v,
+        prev_v,
     ):
         """Scatter per-row values into the slab's device state: row
         ``rows[j]`` takes the j-th value of every value array. This is how
@@ -725,6 +774,9 @@ class InferenceEngine:
             budgets.at[rows].set(budgets_v, mode="drop"),
             pt.at[rows].set(pt_v, mode="drop"),
             buf.at[rows].set(buf_v, mode="drop"),
+            ptoks.at[rows].set(ptoks_v, mode="drop"),
+            plens.at[rows].set(plens_v, mode="drop"),
+            prev.at[rows].set(prev_v, mode="drop"),
         )
 
     def _admit_merge_impl(
@@ -737,6 +789,9 @@ class InferenceEngine:
         budgets,
         pt,
         buf,
+        ptoks,
+        plens,
+        prev,
         rows,
         cur0,
         st0,
@@ -744,13 +799,19 @@ class InferenceEngine:
         pos_v,
         budgets_v,
         pt_v,
+        ptoks_v,
+        plens_v,
+        prev_v,
     ):
         """Scatter a freshly-prefilled admission cohort into the device slab
         state with ZERO host fetches: ``cur0``/``st0``/``done0`` are
         ``_admit_impl``'s output handles, chained device-to-device. Rows
         whose first sample was already EOS (``done0``) enter with emitted=0
         and retire empty at their first harvest. ``rows[j] == B`` entries
-        (bucket padding / inactive lanes) are dropped by the scatter."""
+        (bucket padding / inactive lanes) are dropped by the scatter.
+        ``ptoks_v`` [A, prompt_cap] / ``plens_v`` / ``prev_v`` seed the
+        draft-lookup prompt buffer (host-padded to the static buffer
+        width, so this executable stays per-A, not per-(A, T))."""
         pad = self.tokenizer.pad_id
         W = buf.shape[1]
         A = rows.shape[0]
@@ -768,6 +829,9 @@ class InferenceEngine:
             budgets.at[rows].set(budgets_v, mode="drop"),
             pt.at[rows].set(pt_v, mode="drop"),
             buf,
+            ptoks.at[rows].set(ptoks_v, mode="drop"),
+            plens.at[rows].set(plens_v, mode="drop"),
+            prev.at[rows].set(prev_v, mode="drop"),
         )
 
     def _poll_admissions(self, slab: "_Slab") -> None:
@@ -828,6 +892,9 @@ class InferenceEngine:
                 (np.zeros((B,), np.int32), rs),
                 (np.zeros((B, slab.page_table.shape[1]), np.int32), rs2),
                 (np.full((B, slab.steps), slab.pad_id, np.int32), rs2),
+                (np.full((B, slab.prompt_cap), slab.pad_id, np.int32), rs2),
+                (np.zeros((B,), np.int32), rs),
+                (np.full((B,), slab.pad_id, np.int32), rs),
             ),
         )
 
@@ -924,6 +991,7 @@ class InferenceEngine:
         dfa_dist,
         dfa_active,
         dfa_eos,
+        dfa_inv,  # unused here; *dfa call sites pass the full 6-tuple
         first_logits,
         budgets,
         active,
@@ -1126,6 +1194,7 @@ class InferenceEngine:
         dfa_dist,
         dfa_active,
         dfa_eos,
+        dfa_inv,
         cur,
         pos,
         st,
@@ -1136,12 +1205,16 @@ class InferenceEngine:
         paged_k,
         paged_v,
         out_buf,
+        prompt_toks,
+        prompt_lens,
+        prev,
         key,
         *,
         iters: int,
         chunk: int,
         temperature: float,
         constrained: bool,
+        draft: bool,
     ):
         """One bounded decode segment over the whole slab: up to ``iters``
         model forwards (each a ``chunk``-wide grammar fast-forward chunk when
@@ -1156,10 +1229,25 @@ class InferenceEngine:
         plan grammar). ``chunk=1`` is the plain one-token-per-forward loop;
         greedy outputs are bit-identical across chunk widths (tested).
 
+        Prompt-lookup draft speculation (``draft``, greedy/constrained
+        only): positions fast-forward can't force — trie branch points,
+        free strings — are filled with the continuation after the last
+        (prev, cur) bigram match in the row's own prompt (plans echo
+        shortlist names and schema keys verbatim), and the whole proposal
+        chain is verified per-position against the budget-masked greedy
+        argmax over COMPACT column logits (``decode_chunk_paged``'s
+        ``active_cols`` path — the full-vocab [B, S, V] buffer never
+        exists). Verification IS the greedy sample, so accepted tokens are
+        exactly what sequential greedy decode would emit: output-identical
+        to draft-off, more tokens per forward. Auto-off at temperature>0
+        (probabilistic acceptance not implemented); forced tokens always
+        pass verification (their mask has one legal column), so this path
+        strictly generalises fast-forward.
+
         Emissions are written at absolute slots ``out_buf[b, emitted..]`` so
         rows admitted at different segment boundaries coexist in one slab.
         Returns (cur, pos, st, emitted, done, pools_k, pools_v, out_buf,
-        n_forwards).
+        prev, n_forwards).
         """
         cfg = self.model_cfg
         tok = self.tokenizer
@@ -1170,13 +1258,151 @@ class InferenceEngine:
         budget_mask = self._budget_mask
         pad, eos = tok.pad_id, tok.eos_id
         b_idx = jnp.arange(B)
+        use_draft = draft and constrained and chunk > 1 and temperature <= 0.0
 
         def cond(c):
-            it, cur, pos, st, e, done, k_p, v_p, buf, key = c
+            it, cur, pos, st, e, done, k_p, v_p, buf, prev, key = c
             return (it < iters) & jnp.any(~done)
 
+        def draft_body(c):
+            from mcpx.engine.sampling import NEG_INF
+
+            it, cur, pos, st, e, done, k_p, v_p, buf, prev, key = c
+            J = chunk - 1
+            Lp = prompt_toks.shape[1]
+            j_ar = jnp.arange(J)
+
+            # --- continuation after the LAST (prev, cur) bigram match in
+            # the row's own prompt (latest occurrence = most local context).
+            pi = jnp.arange(Lp - 1)
+            m = (prompt_toks[:, :-1] == prev[:, None]) & (
+                prompt_toks[:, 1:] == cur[:, None]
+            )
+            m &= (pi[None, :] + 2) < prompt_lens[:, None]
+            has = jnp.any(m, axis=1)
+            last_i = (Lp - 2) - jnp.argmax(m[:, ::-1], axis=1)
+            cont_idx = last_i[:, None] + 2 + j_ar[None, :]
+            cont_ok = has[:, None] & (cont_idx < prompt_lens[:, None])
+            cont = jnp.take_along_axis(
+                prompt_toks, jnp.clip(cont_idx, 0, Lp - 1), axis=1
+            )
+            cont = jnp.where(cont_ok, cont, pad)  # [B, J]
+            cont_col = dfa_inv[cont]  # [B, J]; -1 = active in no state
+
+            # --- proposal chain: forced tokens (always) + draft tokens
+            # while the realized chain stays in sync with the continuation.
+            def prop_step(carry, xs):
+                s, alive, sync = carry
+                c_tok, c_col, c_ok = xs
+                row = mask_tab[s]  # [B, C]
+                f_col = jnp.argmax(row, axis=-1).astype(jnp.int32)
+                is_forced = jnp.sum(row, axis=-1) == 1
+                c_col_c = jnp.maximum(c_col, 0)
+                d_legal = (
+                    c_ok
+                    & (c_col >= 0)
+                    & jnp.take_along_axis(row, c_col_c[:, None], axis=1)[:, 0]
+                    & ~dfa_eos[c_col_c]
+                )
+                p_col = jnp.where(is_forced, f_col, c_col_c)
+                use = alive & jnp.where(
+                    is_forced, ~dfa_eos[f_col], sync & d_legal
+                )
+                p_tok = dfa_active[p_col]
+                return (
+                    jnp.where(use, trans[s, p_col], s),
+                    use,
+                    sync & (p_tok == c_tok),
+                ), (jnp.where(use, p_tok, pad), p_col, use, s)
+
+            (s_fin, _, _), (p_toks, p_cols, p_use, s_before) = lax.scan(
+                prop_step,
+                (st, ~done, jnp.ones((B,), bool)),
+                (cont.T, cont_col.T, cont_ok.T),
+            )
+            p_toks, p_cols, p_use = p_toks.T, p_cols.T, p_use.T  # [B, J]
+            s_before = jnp.moveaxis(s_before, 0, 1)  # [B, J]
+
+            # --- one forward over [cur, proposals], compact logits at
+            # EVERY chunk position (verification needs them all).
+            chunk_toks = jnp.concatenate([cur[:, None], p_toks], axis=1)
+            logits_c, kv = decode_chunk_paged(
+                params,
+                cfg,
+                chunk_toks,
+                pos,
+                page_table,
+                {"k": k_p, "v": v_p},
+                use_pallas=self._use_pallas,
+                interpret=self.config.engine.interpret,
+                active_cols=dfa_active,
+            )  # [B, chunk, C] float32
+
+            # --- verify: accepted prefix = positions where the proposal IS
+            # the budget-masked greedy argmax (the same mask formula as
+            # _budget_mask, vectorised over chunk positions).
+            rem_j = budgets[:, None] - e[:, None] - j_ar[None, :] - 1
+            legal_j = mask_tab[s_before]  # [B, J, C]
+            finish_j = legal_j & (
+                dfa_eos[None, None, :]
+                | (dfa_dist[trans[s_before]] <= rem_j[..., None])
+            )
+            feas_j = jnp.any(finish_j, axis=-1, keepdims=True)
+            m_j = jnp.where(feas_j, finish_j, legal_j)
+            v = jnp.where(m_j, logits_c[:, :J, :], NEG_INF)
+            vmax = jnp.argmax(v, axis=-1)  # [B, J]
+            ok = (
+                p_use
+                & (vmax == p_cols)
+                & (e[:, None] + j_ar[None, :] < budgets[:, None])
+            )
+            acc = jnp.cumprod(ok.astype(jnp.int32), axis=1).astype(bool)
+            a = jnp.sum(acc, axis=1).astype(jnp.int32)  # [B] accepted count
+
+            # --- correction token from the first unaccepted position (the
+            # standard speculation bonus: a+1 tokens per forward).
+            s_full = jnp.concatenate([s_before, s_fin[:, None]], axis=1)
+            st1 = s_full[b_idx, a]
+            e1 = e + a
+            key, sub = jax.random.split(key)
+            mask = budget_mask(dfa, st1, budgets - e1 - 1)
+            col = sample(
+                logits_c[b_idx, a],
+                sub,
+                temperature=temperature,
+                top_k=self.config.engine.top_k,
+                mask=mask,
+            ).astype(jnp.int32)
+            nxt_id = dfa_active[col]
+            newly_done = done | dfa_eos[col] | (e1 >= budgets)
+            st_next = jnp.where(newly_done, st1, trans[st1, col])
+            nxt = jnp.where(newly_done, pad, nxt_id)
+
+            idx_p = jnp.where(acc, e[:, None] + j_ar[None, :], W)
+            buf = buf.at[b_idx[:, None], idx_p].set(p_toks, mode="drop")
+            buf = buf.at[b_idx, jnp.where(newly_done, W, e1)].set(
+                nxt, mode="drop"
+            )
+            adv = jnp.where(done, 0, 1) + a  # p_use has ~done, so a=0 there
+            prev2 = jnp.where(
+                done | newly_done, prev, chunk_toks[b_idx, a]
+            )
+            return (
+                it + 1,
+                nxt,
+                pos + adv,
+                st_next,
+                e1 + jnp.where(newly_done, 0, 1),
+                newly_done,
+                kv["k"],
+                kv["v"],
+                buf,
+                prev2,
+                key,
+            )
+
         def body(c):
-            it, cur, pos, st, e, done, k_p, v_p, buf, key = c
+            it, cur, pos, st, e, done, k_p, v_p, buf, prev, key = c
 
             if chunk > 1 and constrained:
                 # Fast-forward: chain of forced tokens after `cur`. Emission
@@ -1259,6 +1485,13 @@ class InferenceEngine:
                 st_next = st1
             nxt = jnp.where(newly_done, pad, nxt_id)
             buf = buf.at[b_idx, jnp.where(newly_done, W, e1)].set(nxt, mode="drop")
+            # prev = the token immediately before the new cur: the chain's
+            # last consumed token (cur itself when nothing rode along).
+            prev2 = jnp.where(
+                done | newly_done,
+                prev,
+                chunk_toks[b_idx, jnp.maximum(adv - 1, 0)],
+            )
             return (
                 it + 1,
                 nxt,
@@ -1269,6 +1502,7 @@ class InferenceEngine:
                 kv["k"],
                 kv["v"],
                 buf,
+                prev2,
                 key,
             )
 
@@ -1282,10 +1516,13 @@ class InferenceEngine:
             paged_k,
             paged_v,
             out_buf,
+            prev,
             key,
         )
-        it, cur, pos, st, e, done, k_p, v_p, buf, key = lax.while_loop(cond, body, init)
-        return cur, pos, st, e, done, k_p, v_p, buf, it
+        it, cur, pos, st, e, done, k_p, v_p, buf, prev, key = lax.while_loop(
+            cond, draft_body if use_draft else body, init
+        )
+        return cur, pos, st, e, done, k_p, v_p, buf, prev, it
 
     # --- worker -----------------------------------------------------------
     def _worker(self) -> None:
@@ -1677,12 +1914,26 @@ class InferenceEngine:
         rows_arr[: len(rows_idx)] = rows_idx
         pos_arr = np.zeros((A,), np.int32)
         pos_arr[: len(cohort)] = P + seq_lens[: len(cohort)]
+        # Draft-lookup seed: the cohort's (suffix) prompt tokens padded to
+        # the slab's static buffer width (keeps the admit-merge executable
+        # per-A instead of per-(A, T)), plus each row's last prompt token as
+        # the initial ``prev`` half of the match bigram.
+        ptoks_arr = np.full((A, slab.prompt_cap), tok.pad_id, np.int32)
+        ptoks_arr[:, : min(T, slab.prompt_cap)] = tokens[:, : slab.prompt_cap]
+        prev_arr = np.full((A,), tok.pad_id, np.int32)
+        for j in range(len(cohort)):
+            prev_arr[j] = tokens[j, seq_lens[j] - 1]
         rs = self._row_spec(A)
         try:
             state = self._dev_state(slab)
             # budgets_d/table_d from the admission upload are still live
             # (prefill donates only the pools) — reuse, don't re-upload.
-            rows_d, pos_d = self._put_many((rows_arr, rs), (pos_arr, rs))
+            rows_d, pos_d, ptoks_d, prev_d = self._put_many(
+                (rows_arr, rs),
+                (pos_arr, rs),
+                (ptoks_arr, self._row_spec(A, 1)),
+                (prev_arr, rs),
+            )
             slab.dev = self._jit_admit_merge(
                 *state,
                 rows_d,
@@ -1692,6 +1943,9 @@ class InferenceEngine:
                 pos_d,
                 budgets_d,
                 table_d,
+                ptoks_d,
+                lens_d,  # still live: prefill donates only the pools
+                prev_d,
             )
         except BaseException as e:  # noqa: BLE001 - rows already assigned
             self._fail_rows(slab, e)
@@ -1741,9 +1995,10 @@ class InferenceEngine:
         self.metrics.segment_active_rows.inc(slab.n_active)
         dfa = self._dfa_for(slab.grammar or self.grammar)
         self._seg_counter += 1
-        cur_d, pos_d, st_d, e_d, done_d, budgets_d, pt_d, buf_in = self._dev_state(
-            slab
-        )
+        (
+            cur_d, pos_d, st_d, e_d, done_d, budgets_d, pt_d, buf_in,
+            ptoks_d, plens_d, prev_d,
+        ) = self._dev_state(slab)
         out = self._jit_segment(
             self._params,
             *dfa,
@@ -1757,15 +2012,22 @@ class InferenceEngine:
             self._paged_kv["k"],
             self._paged_kv["v"],
             buf_in,
+            ptoks_d,
+            plens_d,
+            prev_d,
             jax.random.PRNGKey((self._rng_base + self._seg_counter) & 0x7FFFFFFF),
             iters=iters,
             chunk=chunk,
             temperature=slab.temperature,
             constrained=slab.constrained,
+            draft=ecfg.draft_mode == "prompt",
         )
-        cur_d, pos_d, st_d, e_d, done_d, k_p, v_p, buf_d, n_fwd = out
+        cur_d, pos_d, st_d, e_d, done_d, k_p, v_p, buf_d, prev_d, n_fwd = out
         self._paged_kv = {"k": k_p, "v": v_p}
-        slab.dev = (cur_d, pos_d, st_d, e_d, done_d, budgets_d, pt_d, buf_d)
+        slab.dev = (
+            cur_d, pos_d, st_d, e_d, done_d, budgets_d, pt_d, buf_d,
+            ptoks_d, plens_d, prev_d,
+        )
         self._inflight.append((done_d, e_d, buf_d, n_fwd, slab.gen.copy()))
 
     def _harvest(self, slab: "_Slab", keep_inflight: int) -> None:

@@ -36,6 +36,7 @@ def decode_chunk_paged(
     use_pallas: bool = True,
     interpret: bool = False,
     logits_at: "jax.Array | None" = None,  # [B] chunk slot per row, or None
+    active_cols: "jax.Array | None" = None,  # [C] token ids: compact unembed
 ) -> tuple[jax.Array, dict[str, jax.Array]]:
     """Multi-token decode step: S new tokens per sequence in ONE forward.
 
@@ -117,6 +118,20 @@ def decode_chunk_paged(
         params["layers"],
     )
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    if active_cols is not None:
+        # Draft verification needs logits at EVERY chunk position, but only
+        # over the grammar's C active columns: gather those unembed rows
+        # and contract against them — [B, S, C] instead of [B, S, V]. At a
+        # 256k SentencePiece vocab with a few-thousand-column grammar this
+        # is ~100x less unembed compute/memory than full-vocab all-position
+        # logits, which is what makes per-position verification affordable
+        # at all (the "last-only unembed" optimisation stays intact for the
+        # non-draft path below).
+        w = params["embed"][active_cols]  # [C, D]
+        logits_c = jnp.einsum(
+            "bsd,cd->bsc", x, w, preferred_element_type=jnp.float32
+        )
+        return logits_c, {"k": k_new, "v": v_new}
     if logits_at is not None:
         # Serving only reads ONE position's logits per row (the last valid
         # chunk slot): gather the hidden state BEFORE the unembed so the

@@ -213,14 +213,17 @@ class PlanGrammar:
         return self.active_ids.shape[0]
 
     def device_tables(self, pad_multiple: int = 512):
-        """(ctrans, cmask, dist, active_ids, eos_cols) as device arrays,
-        state dim padded to a multiple of ``pad_multiple`` and columns padded
-        to ``_col_bucket``. The decode loop takes these as ARGUMENTS (not
-        closure constants), so grammars with the same padded shape share one
-        compiled executable — a registry update swaps tables without
-        recompiling, and recompiles happen only when a pad bucket changes.
-        Padding rows/columns are inert: mask False, transitions to the dead
-        state, active id PAD (whose logit is masked anyway)."""
+        """(ctrans, cmask, dist, active_ids, eos_cols, inv_cols) as device
+        arrays, state dim padded to a multiple of ``pad_multiple`` and
+        columns padded to ``_col_bucket``. The decode loop takes these as
+        ARGUMENTS (not closure constants), so grammars with the same padded
+        shape share one compiled executable — a registry update swaps tables
+        without recompiling, and recompiles happen only when a pad bucket
+        changes. Padding rows/columns are inert: mask False, transitions to
+        the dead state, active id PAD (whose logit is masked anyway).
+        ``inv_cols`` [V] maps token id → compact column (or -1 when the
+        token is active in no state) — how prompt-lookup draft tokens enter
+        compact column space (engine draft speculation)."""
         if self._device is None or self._device_pad != pad_multiple:
             import jax.numpy as jnp
 
@@ -237,12 +240,15 @@ class PlanGrammar:
             ids[:c] = self.active_ids
             eos = np.zeros((C,), bool)
             eos[:c] = self.eos_cols
+            inv = np.full((self.tokenizer.vocab_size,), -1, np.int32)
+            inv[self.active_ids] = np.arange(c, dtype=np.int32)
             self._device = (
                 jnp.asarray(trans),
                 jnp.asarray(mask),
                 jnp.asarray(dist),
                 jnp.asarray(ids),
                 jnp.asarray(eos),
+                jnp.asarray(inv),
             )
             self._device_pad = pad_multiple
         return self._device

@@ -356,7 +356,7 @@ def test_trie_rejects_unencodable_names():
 def test_device_tables_pad_and_share():
     tok = ByteTokenizer()
     g = build_plan_grammar(tok, ["a-svc", "b-svc"])
-    trans, mask, dist, active_ids, eos_cols = g.device_tables()
+    trans, mask, dist, active_ids, eos_cols, inv_cols = g.device_tables()
     n, c = g.ctrans.shape
     assert trans.shape[0] % 512 == 0 and trans.shape[0] >= n
     assert trans.shape[1] >= c and trans.shape == mask.shape
@@ -380,6 +380,11 @@ def test_device_tables_pad_and_share():
     assert tok.eos_id in g.active_ids
     assert tok.pad_id not in g.active_ids
     assert bool(g.eos_cols[np.flatnonzero(g.active_ids == tok.eos_id)[0]])
+    # inv_cols is the exact inverse of active_ids; inactive ids map to -1
+    inv_np = np.asarray(inv_cols)
+    assert inv_np.shape == (tok.vocab_size,)
+    np.testing.assert_array_equal(inv_np[g.active_ids], np.arange(c))
+    assert inv_np[tok.pad_id] == -1
 
 
 def test_engine_pad_makes_registry_grammar_share_warmup_shape():
