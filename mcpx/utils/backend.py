@@ -1,0 +1,53 @@
+"""Backend arming: pin a process to an n-device virtual CPU platform.
+
+The driver image's sitecustomize registers the axon TPU plugin and forces
+``jax_platforms="axon,cpu"`` via ``jax.config`` at interpreter start — so
+``JAX_PLATFORMS=cpu`` in the environment is silently overridden, and any
+process that merely imports jax dials the single-client TPU tunnel. For
+host-side work (planner training, corpus building, offline evals) that is
+both wrong (it contends with a serving/bench process for the one tunnel
+session) and slow. This helper is the one arming recipe, shared by
+``tests/conftest.py``, ``__graft_entry__.dryrun_multichip`` and the CLI's
+``--platform cpu`` flags, so the three can't drift.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_virtual_cpu(n_devices: int = 1) -> None:
+    """Arm an ``n_devices`` virtual CPU platform, even if JAX already
+    latched onto a different backend. Recipe: set XLA_FLAGS + JAX_PLATFORMS
+    (covers subprocesses / not-yet-imported jax), force ``jax_platforms``
+    via jax.config (beats the sitecustomize override), and drop any
+    already-initialized backend so the new flags take effect."""
+    # XLA_FLAGS is parsed once per process, so for the already-latched case
+    # below we rely on jax_num_cpu_devices (config-time, re-read on client
+    # creation) instead.
+    flags = [
+        f
+        for f in os.environ.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+    os.environ["XLA_FLAGS"] = " ".join(flags)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+    from jax._src import xla_bridge
+
+    if xla_bridge.backends_are_initialized():
+        if jax.default_backend() == "cpu" and len(jax.devices()) == n_devices:
+            return  # already armed (e.g. under tests/conftest.py)
+        jax.clear_caches()
+        from jax.extend import backend as jeb
+
+        jeb.clear_backends()
+    jax.config.update("jax_num_cpu_devices", n_devices)
+    jax.config.update("jax_platforms", "cpu")
+    got = len(jax.devices("cpu"))
+    if got != n_devices:
+        raise RuntimeError(
+            f"virtual CPU platform has {got} devices, wanted {n_devices}"
+        )
