@@ -51,7 +51,7 @@ def _snap(eng):
 
 async def run_one(*, model: str, n_req: int, batch: int, tick: int, spec: int,
                   with_keys: bool, depth: int, vocab: str, minfree: int,
-                  wait: float, budget: int) -> dict:
+                  wait: float, budget: int, draft: str = "prompt") -> dict:
     from mcpx.core.config import MCPXConfig
     from mcpx.engine.engine import InferenceEngine
     from mcpx.planner.grammar import build_plan_grammar
@@ -74,6 +74,7 @@ async def run_one(*, model: str, n_req: int, batch: int, tick: int, spec: int,
                 "pipeline_depth": depth,
                 "admit_min_free": minfree,
                 "admit_max_wait_s": wait,
+                "draft_mode": draft,
             },
         }
     )
@@ -113,7 +114,7 @@ async def run_one(*, model: str, n_req: int, batch: int, tick: int, spec: int,
     out = {
         "model": model, "batch": batch, "tick": tick, "spec": spec,
         "depth": depth, "vocab": vocab, "minfree": minfree, "wait": wait,
-        "budget": budget,
+        "budget": budget, "draft": draft,
         "keys": int(with_keys), "requests": n_req,
         "plans_per_sec": round(n_req / dt, 2),
         "elapsed_s": round(dt, 2),
@@ -148,6 +149,7 @@ def _base() -> dict:
         "minfree": int(os.environ.get("PROBE_MINFREE", "0")),
         "wait": float(os.environ.get("PROBE_WAIT", "0.15")),
         "budget": int(os.environ.get("PROBE_BUDGET", "96")),
+        "draft": os.environ.get("PROBE_DRAFT", "prompt"),
     }
 
 
@@ -172,6 +174,8 @@ async def main() -> None:
                     c["model"] = v
                 elif k == "vocab":
                     c["vocab"] = v
+                elif k == "draft":
+                    c["draft"] = v
                 else:
                     raise SystemExit(f"unknown sweep key {k!r}")
             configs.append(c)

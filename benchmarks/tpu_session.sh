@@ -32,11 +32,29 @@ timeout 3000 python bench.py 2> >(tail -5 >&2) | grep -E '^\{' | tail -1 > bench
 keep_if_json benchmarks/.bench_tpu.tmp benchmarks/bench_tpu.json
 cat benchmarks/bench_tpu.json 2>/dev/null
 
+# r5 honesty/measurement rows (smaller request counts: each is one labelled
+# row, not the headline): OOD registry (unfitted BPE compression), repeat-
+# intent plan-cache lever, SP-vocab real-checkpoint serving configuration.
+MCPX_BENCH_REGISTRY=ood MCPX_BENCH_REQUESTS=256 MCPX_BENCH_LATENCY_REQUESTS=96 MCPX_BENCH_SKIP_QUALITY=1 \
+  timeout 1800 python bench.py 2> >(tail -3 >&2) | grep -E '^\{' | tail -1 > benchmarks/.bench_ood.tmp
+keep_if_json benchmarks/.bench_ood.tmp benchmarks/bench_tpu_ood.json
+cat benchmarks/bench_tpu_ood.json 2>/dev/null
+
+MCPX_BENCH_UNIQUE_INTENTS=64 MCPX_BENCH_REQUESTS=512 MCPX_BENCH_LATENCY_REQUESTS=96 MCPX_BENCH_SKIP_QUALITY=1 \
+  timeout 1800 python bench.py 2> >(tail -3 >&2) | grep -E '^\{' | tail -1 > benchmarks/.bench_cache.tmp
+keep_if_json benchmarks/.bench_cache.tmp benchmarks/bench_tpu_cache.json
+cat benchmarks/bench_tpu_cache.json 2>/dev/null
+
+MCPX_BENCH_VOCAB=sp MCPX_BENCH_REQUESTS=256 MCPX_BENCH_LATENCY_REQUESTS=96 MCPX_BENCH_SKIP_QUALITY=1 \
+  timeout 2400 python bench.py 2> >(tail -3 >&2) | grep -E '^\{' | tail -1 > benchmarks/.bench_sp.tmp
+keep_if_json benchmarks/.bench_sp.tmp benchmarks/bench_tpu_sp.json
+cat benchmarks/bench_tpu_sp.json 2>/dev/null
+
 timeout 3000 python benchmarks/ladder.py 2> >(tail -5 >&2) > benchmarks/.ladder_tpu.tmp
 keep_if_nonempty benchmarks/.ladder_tpu.tmp benchmarks/ladder_tpu.json
 cat benchmarks/ladder_tpu.json 2>/dev/null
 
-PROBE_SWEEP="budget=40;budget=32;budget=48;budget=40,tick=2;budget=40,minfree=1;budget=40,minfree=16;budget=40,spec=4;budget=40,depth=3" \
+PROBE_SWEEP="budget=40;budget=32;budget=48;budget=40,tick=2;budget=40,minfree=1;budget=40,minfree=16;budget=40,spec=4;budget=40,depth=3;budget=40,draft=off;budget=40,tick=1;budget=40,tick=8" \
   timeout 3500 python benchmarks/engine_probe.py 2>&1 | grep -E '^\{' > benchmarks/.probe_sweep_tpu.tmp
 keep_if_nonempty benchmarks/.probe_sweep_tpu.tmp benchmarks/probe_sweep_tpu.txt
 cat benchmarks/probe_sweep_tpu.txt 2>/dev/null
