@@ -232,6 +232,18 @@ class PlannerConfig:
     # (free-string keys sample every character). Set "off" if callers pass
     # payload keys outside any schema.
     constrain_input_keys: str = "registry"
+    # Drop LLM-emitted edges a->b where no output key of a's service is an
+    # input key of b's service (per the registry's schemas) — after the
+    # planner has rewired the keys that DO overlap to read a's result
+    # (LLMPlanner._normalize_dataflow). A pruned edge is not a no-op: the
+    # executor would have made b wait for a and skip b on a's failure. The
+    # default drops it anyway because the planner's teacher distribution
+    # defines edges as dataflow, so a no-data edge from the model is an
+    # imitation error that serializes — and failure-couples — services that
+    # share nothing. Set False if your LLM plans intentionally use edges as
+    # control-flow-only ordering. Applies only to LLM-authored plans; graphs
+    # submitted to /execute are never modified.
+    prune_dataflow_free_edges: bool = True
 
 
 @dataclass

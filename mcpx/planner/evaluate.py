@@ -30,8 +30,9 @@ async def evaluate_planner(
     host must not lower Mosaic TPU kernels)."""
     import jax
 
-    from mcpx.core.config import MCPXConfig
-    from mcpx.planner.quality import mean_quality, plan_quality
+    from mcpx.core.config import MCPXConfig, PlannerConfig
+    from mcpx.planner.heuristic import HeuristicPlanner
+    from mcpx.planner.quality import mean_quality, node_f1, plan_quality
     from mcpx.server.factory import build_control_plane
     from mcpx.utils.synth import intent_for, synth_registry
 
@@ -59,6 +60,11 @@ async def evaluate_planner(
                 "kind": "llm",
                 "max_plan_retries": 0,
                 "shortlist_top_k": shortlist_top_k,
+                # Eval measures the MODEL's raw emissions: serving-path
+                # normalization (dataflow rewiring/pruning) would mask
+                # imitation errors — pruning a model's bad edge must show
+                # up as incoherence here, not vanish.
+                "prune_dataflow_free_edges": False,
             },
         }
     )
@@ -71,6 +77,13 @@ async def evaluate_planner(
     rng = random.Random(seed)
     rows: list[dict] = []
     origins: dict[str, int] = {}
+    f1s: list[float] = []
+    # Imitation-fidelity reference: the schema-chaining teacher the model
+    # was trained to imitate (models/corpus.py), planning over the SAME
+    # deterministic retrieval shortlist the served request used.
+    teacher = HeuristicPlanner(
+        PlannerConfig(kind="heuristic", shortlist_top_k=shortlist_top_k)
+    )
     try:
         for _ in range(n_intents):
             intent = intent_for(records, rng, n_services=rng.randint(2, 4))
@@ -78,10 +91,19 @@ async def evaluate_planner(
             origin = plan.origin or "unknown"
             origins[origin] = origins.get(origin, 0) + 1
             rows.append(plan_quality(plan, intent, by_name))
+            if origin == "llm":
+                # Fidelity is only meaningful for MODEL output: a fallback
+                # plan comes from the same schema-chaining algorithm as the
+                # teacher, so scoring it would award a broken checkpoint
+                # (llm_share 0) a perfect node_f1.
+                reference = await teacher.plan(intent, await cp._context(intent))
+                f1s.append(node_f1(plan, reference))
     finally:
         engine = getattr(cp.planner, "engine", None)
         if engine is not None and engine.state == "ready":
             await engine.aclose()
     out = mean_quality(rows)
     out["llm_share"] = origins.get("llm", 0) / max(1, sum(origins.values()))
+    out["node_f1"] = sum(f1s) / len(f1s) if f1s else 0.0
+    out["node_f1_n"] = len(f1s)
     return out

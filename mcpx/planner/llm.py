@@ -253,6 +253,7 @@ class LLMPlanner:
                 log.info("plan attempt %d names unknown services %s", attempt, unknown)
                 continue
             self._resolve(plan, by_name)
+            n_pruned = self._normalize_dataflow(plan, by_name)
             plan.intent = intent
             plan.origin = "llm"
             if self.config.explain:
@@ -260,6 +261,8 @@ class LLMPlanner:
                     " [repaired: dangling/backward next-references pruned]"
                     if repaired
                     else ""
+                ) + (
+                    f" [{n_pruned} dataflow-free edge(s) pruned]" if n_pruned else ""
                 )
             return plan
 
@@ -445,6 +448,65 @@ class LLMPlanner:
             return Plan.from_json(json.dumps({"steps": kept}))
         except PlanValidationError:
             return None
+
+    def _normalize_dataflow(
+        self, plan: Plan, by_name: dict[str, ServiceRecord]
+    ) -> int:
+        """Make the LLM plan's declared topology into real dataflow.
+
+        The step wire shape gives ``inputs = {key: key}``, but the executor
+        resolves an input's source against ``results`` — which is keyed by
+        NODE NAME (``executor.py``; same for the reference,
+        ``control_plane.py:102,107``) — before falling back to the request
+        payload. Left as-is, an LLM plan's downstream steps would read every
+        input from the payload and upstream outputs would never flow. So for
+        every emitted edge a->b, each input key of b that a's service
+        produces (per the registry's schemas — authoritative, SURVEY.md
+        §2.4) is rewired to read a's result (first producer wins, matching
+        the schema-chaining teacher ``heuristic.py:_chain``).
+
+        Edges left carrying NO dataflow after rewiring are then pruned when
+        ``config.prune_dataflow_free_edges`` (default on). Interpretation
+        choice, stated plainly: a dataflow-free edge still has executor
+        semantics (b waits for a; b is skipped if a fails), but the teacher
+        distribution this model imitates defines edges AS dataflow, so a
+        no-data edge from the student is an imitation error that serializes
+        — and failure-couples — services that share nothing. Operators whose
+        LLM plans intentionally encode control-flow-only ordering set the
+        flag off. Only LLM-authored plans are normalized; hand-authored
+        ``/execute`` graphs are never touched. Returns the number of edges
+        pruned; nodes left without in-edges become parallel roots."""
+        by_node = {n.name: n for n in plan.nodes}
+        unknown: set[tuple[str, str]] = set()
+        for e in plan.edges:
+            src_rec = by_name.get(by_node[e.src].service) if e.src in by_node else None
+            dst_node = by_node.get(e.dst)
+            dst_rec = by_name.get(dst_node.service) if dst_node else None
+            if src_rec is None or dst_rec is None:
+                unknown.add((e.src, e.dst))  # leave untouched
+                continue
+            shared = src_rec.output_schema.keys() & dst_rec.input_schema.keys()
+            for key in sorted(shared):
+                # Rewire payload-style self-references only; an earlier
+                # edge's producer (or an explicit mapping) is not clobbered.
+                if dst_node.inputs.get(key) == key:
+                    dst_node.inputs[key] = e.src
+        if not self.config.prune_dataflow_free_edges:
+            return 0
+        # Carrying = some input of dst actually READS src after rewiring —
+        # not mere schema overlap: a second producer of an already-wired key
+        # (first producer won) moves nothing and is pruned like any other
+        # no-data edge.
+        kept = [
+            e
+            for e in plan.edges
+            if (e.src, e.dst) in unknown
+            or any(v == e.src for v in by_node[e.dst].inputs.values())
+        ]
+        pruned = len(plan.edges) - len(kept)
+        if pruned:
+            plan.edges = kept
+        return pruned
 
     def _resolve(self, plan: Plan, by_name: dict[str, ServiceRecord]) -> None:
         """Fill endpoints/fallbacks/costs from the registry (LLM output is

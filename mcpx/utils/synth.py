@@ -22,37 +22,6 @@ _KEYS = [
     "address", "score", "status", "report", "features", "vector", "summary",
 ]
 
-
-def synth_registry(n: int, seed: int = 0, local: bool = True) -> list[ServiceRecord]:
-    rng = random.Random(seed)
-    records: list[ServiceRecord] = []
-    for i in range(n):
-        domain = _DOMAINS[i % len(_DOMAINS)]
-        verb = _VERBS[(i // len(_DOMAINS)) % len(_VERBS)]
-        name = f"{domain}-{verb}-{i:04d}"
-        n_in = rng.randint(1, 3)
-        n_out = rng.randint(1, 2)
-        input_keys = rng.sample(_KEYS, n_in)
-        output_keys = rng.sample(_KEYS, n_out)
-        scheme = "local" if local else "http"
-        records.append(
-            ServiceRecord(
-                name=name,
-                endpoint=f"{scheme}://{name}",
-                description=f"{verb}s {domain} data for downstream composition",
-                input_schema={k: "str" for k in input_keys},
-                output_schema={k: "str" for k in output_keys},
-                cost_profile={
-                    "latency_ms": round(rng.uniform(5, 80), 1),
-                    "cost": round(rng.uniform(0.1, 2.0), 2),
-                },
-                fallbacks=[f"{scheme}://{name}-fb"] if rng.random() < 0.3 else [],
-                tags=[domain, verb],
-            )
-        )
-    return records
-
-
 _OOD_VERBS = ["Get", "Set", "Sync", "Push", "Resolve", "Compute", "Reconcile", "Emit"]
 _OOD_NOUNS = [
     "Invoice", "Customer", "Ledger", "Shipment", "Session", "Voucher",
@@ -67,27 +36,50 @@ _OOD_KEYS = [
 ]
 
 
-def synth_registry_ood(n: int, seed: int = 0, local: bool = True) -> list[ServiceRecord]:
-    """An OUT-of-distribution registry: camelCase product-style naming with
-    a token universe disjoint from ``synth_registry``'s — the workload the
-    committed BPE vocab was NOT fitted to (its ~6-8x compression is
-    registry-fitted; `tests/test_bpe.py` pins the 1.6-2.1x OOD floor).
-    Bench rows on this registry keep the headline honest (VERDICT r4
-    weak #3). Same chaining structure as ``synth_registry``."""
+def _build_registry(
+    n: int,
+    seed: int,
+    local: bool,
+    *,
+    primary: list[str],
+    secondary: list[str],
+    keys: list[str],
+    name_fmt: str,
+    description_fmt: str,
+    interleaved_draws: bool = False,
+) -> list[ServiceRecord]:
+    """One record-construction loop for every naming universe: the in- and
+    out-of-distribution registries must keep IDENTICAL chaining structure
+    (key-sample sizes, cost ranges, fallback rate) or the OOD bench row
+    stops isolating tokenizer fit from workload shape.
+
+    RNG draw order is a compatibility surface: the committed BPE vocab,
+    checkpoint, and every pinned "registry seed N" protocol artifact depend
+    on the exact historical sequences. The two registries historically drew
+    in DIFFERENT orders (in-dist: both counts, then both samples; OOD:
+    count/sample interleaved) — ``interleaved_draws`` reproduces each
+    byte-for-byte rather than silently regenerating different registries
+    under the same protocol label."""
     rng = random.Random(seed)
     records: list[ServiceRecord] = []
     for i in range(n):
-        noun = _OOD_NOUNS[i % len(_OOD_NOUNS)]
-        verb = _OOD_VERBS[(i // len(_OOD_NOUNS)) % len(_OOD_VERBS)]
-        name = f"{verb}{noun}Svc{i:04d}"
-        input_keys = rng.sample(_OOD_KEYS, rng.randint(1, 3))
-        output_keys = rng.sample(_OOD_KEYS, rng.randint(1, 2))
+        a = primary[i % len(primary)]
+        b = secondary[(i // len(primary)) % len(secondary)]
+        name = name_fmt.format(a=a, b=b, i=i)
+        if interleaved_draws:
+            input_keys = rng.sample(keys, rng.randint(1, 3))
+            output_keys = rng.sample(keys, rng.randint(1, 2))
+        else:
+            n_in = rng.randint(1, 3)
+            n_out = rng.randint(1, 2)
+            input_keys = rng.sample(keys, n_in)
+            output_keys = rng.sample(keys, n_out)
         scheme = "local" if local else "http"
         records.append(
             ServiceRecord(
                 name=name,
                 endpoint=f"{scheme}://{name}",
-                description=f"{verb}s the {noun} aggregate for composition",
+                description=description_fmt.format(a=a, b=b),
                 input_schema={k: "str" for k in input_keys},
                 output_schema={k: "str" for k in output_keys},
                 cost_profile={
@@ -95,10 +87,44 @@ def synth_registry_ood(n: int, seed: int = 0, local: bool = True) -> list[Servic
                     "cost": round(rng.uniform(0.1, 2.0), 2),
                 },
                 fallbacks=[f"{scheme}://{name}-fb"] if rng.random() < 0.3 else [],
-                tags=[noun, verb],
+                tags=[a, b],
             )
         )
     return records
+
+
+def synth_registry(n: int, seed: int = 0, local: bool = True) -> list[ServiceRecord]:
+    return _build_registry(
+        n,
+        seed,
+        local,
+        primary=_DOMAINS,
+        secondary=_VERBS,
+        keys=_KEYS,
+        name_fmt="{a}-{b}-{i:04d}",
+        description_fmt="{b}s {a} data for downstream composition",
+    )
+
+
+def synth_registry_ood(n: int, seed: int = 0, local: bool = True) -> list[ServiceRecord]:
+    """An OUT-of-distribution registry: camelCase product-style naming with
+    a token universe disjoint from ``synth_registry``'s — the workload the
+    committed BPE vocab was NOT fitted to (its ~6-8x compression is
+    registry-fitted; `tests/test_bpe.py` pins the 1.6-2.1x OOD floor).
+    Bench rows on this registry keep the headline honest (VERDICT r4
+    weak #3). Same chaining structure as ``synth_registry`` (shared
+    ``_build_registry`` loop — the structural parity is by construction)."""
+    return _build_registry(
+        n,
+        seed,
+        local,
+        primary=_OOD_NOUNS,
+        secondary=_OOD_VERBS,
+        keys=_OOD_KEYS,
+        name_fmt="{b}{a}Svc{i:04d}",
+        description_fmt="{b}s the {a} aggregate for composition",
+        interleaved_draws=True,
+    )
 
 
 def intent_for(records: list[ServiceRecord], rng: random.Random, n_services: int = 3) -> str:

@@ -354,6 +354,59 @@ def test_repair_prunes_dangling_and_backward_next():
     asyncio.run(go())
 
 
+def test_normalize_dataflow_rewires_and_prunes():
+    """The planner turns an LLM plan's declared topology into real
+    dataflow: step-wire inputs arrive as {key: key} (payload-only under the
+    executor's name-keyed results), so overlapping keys along emitted edges
+    are rewired to read the upstream node's result; an edge left carrying
+    no data after rewiring is pruned (flag-disable restores it)."""
+
+    async def go():
+        reg = await _registry()
+        await reg.put(
+            ServiceRecord(
+                name="audit",
+                endpoint="http://svc/audit",
+                description="audit the request",
+                input_schema={"query": "str"},  # nothing produces "query"
+            )
+        )
+        wire = (
+            '{"steps":['
+            '{"s":"fetch","in":[],"next":["summarize","audit"]},'
+            '{"s":"summarize","in":["data"],"next":[]},'
+            '{"s":"audit","in":["query"],"next":[]}'
+            "]}"
+        )
+        p = LLMPlanner(
+            FakeEngine([wire]), PlannerConfig(kind="llm", max_plan_retries=0)
+        )
+        plan = await p.plan("x", PlanContext(registry=reg))
+        assert plan.origin == "llm"
+        assert [(e.src, e.dst) for e in plan.edges] == [("fetch", "summarize")]
+        assert "1 dataflow-free edge(s) pruned" in plan.explanation
+        # The surviving edge now MOVES data: summarize reads fetch's result
+        # (executor results are keyed by node name), not payload["data"].
+        assert plan.node("summarize").inputs == {"data": "fetch"}
+        # audit keeps its payload wiring and is a parallel root, not
+        # serialized behind a service it shares nothing with.
+        assert plan.node("audit").inputs == {"query": "query"}
+        assert plan.topological_generations()[0] == sorted(["fetch", "audit"])
+
+        p_off = LLMPlanner(
+            FakeEngine([wire]),
+            PlannerConfig(
+                kind="llm", max_plan_retries=0, prune_dataflow_free_edges=False
+            ),
+        )
+        plan_off = await p_off.plan("x", PlanContext(registry=reg))
+        assert len(plan_off.edges) == 2
+        # Rewiring happens regardless of the prune flag.
+        assert plan_off.node("summarize").inputs == {"data": "fetch"}
+
+    asyncio.run(go())
+
+
 def test_token_exact_clamp_packs_subword_prompts():
     """With a subword vocab the clamp is token-exact: the prompt may exceed
     the budget in CHARS (impossible under the old 1-char=1-token clamp) while
