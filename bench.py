@@ -30,6 +30,7 @@ the reference publishes no numbers of its own, SURVEY.md §6).
 
 Environment knobs:
     MCPX_BENCH_MODEL     model size ("2b" default on TPU, "test" on CPU)
+    MCPX_BENCH_BATCH     engine max_batch_size (default 64; lower on HBM OOM)
     MCPX_BENCH_REQUESTS  total /plan requests in phase 1 (default 512)
     MCPX_BENCH_CONCURRENCY  in-flight requests in phase 1 (default 256)
     MCPX_BENCH_SERVICES  registry size (default 1000)
@@ -167,7 +168,11 @@ def _build_config(model_size: str):
             # real-checkpoint serving uses the SentencePiece vocab instead.
             "model": {"size": model_size, "max_seq_len": 2048, "vocab": vocab},
             "engine": {
-                "max_batch_size": 64,
+                # MCPX_BENCH_BATCH: HBM-pressure escape hatch — engine slab
+                # rows scale KV pools + per-bucket executables linearly, so
+                # halving this is the first move when 2b startup hits
+                # RESOURCE_EXHAUSTED on a single chip.
+                "max_batch_size": int(os.environ.get("MCPX_BENCH_BATCH", "64")),
                 # Decode budget is an INFORMATION budget: 40 BPE tokens carry
                 # more JSON than the 96 byte-tokens the old config allowed
                 # (measured ~6-8 chars/token on plan text). Oversizing it
@@ -365,7 +370,10 @@ async def _run(model_size: str, n_requests: int, concurrency: int, n_services: i
             if health.get("engine") in ("ready", "n/a", None):
                 break
             if health.get("engine") == "failed":
-                raise RuntimeError("engine failed during startup")
+                raise RuntimeError(
+                    "engine failed during startup: "
+                    + health.get("engine_error", "(no detail)")
+                )
             await asyncio.sleep(1.0)
 
         async def plan_once(intent: str) -> tuple[int, float]:

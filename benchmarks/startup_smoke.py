@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""2B engine bring-up smoke: the cheapest possible TPU-session first move.
+
+The r5 headline attempt burned its whole 50-minute step on `model=2b`
+engine startup that died with an unobserved RuntimeError (and took the
+axon tunnel down with it — relay gone, same signature as the r3 device
+OOM). This script isolates exactly that bring-up so a fresh tunnel window
+spends minutes, not the session, finding out whether 2B serves.
+
+Two modes:
+
+  --single BATCH   (child) one bring-up attempt at that batch in THIS
+                   process: build bench's exact 2B config, engine.start()
+                   under a watchdog (MCPX_SMOKE_TIMEOUT_S, default 900),
+                   one constrained generate through the registry grammar,
+                   aclose(); print one JSON line; exit 0 on success.
+
+  (no args)        (driver) run `--single B` for each B in
+                   MCPX_SMOKE_BATCHES (default "64,32") as a SUBPROCESS —
+                   a failed or wedged attempt's HBM (and any stuck worker
+                   thread) dies with its process instead of poisoning the
+                   next attempt with RESOURCE_EXHAUSTED it didn't earn.
+                   The driver itself never imports jax, so it holds no
+                   tunnel client. First success wins; its JSON is echoed.
+
+Exit 0 iff some batch served. The session script keys on the printed
+batch to set MCPX_BENCH_BATCH for the real bench run, and falls back to
+MCPX_BENCH_MODEL=test when no batch serves.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_single(batch: int) -> int:
+    import asyncio
+    import faulthandler
+    import traceback
+
+    faulthandler.dump_traceback_later(
+        float(os.environ.get("MCPX_SMOKE_HANG_DUMP_S", "1100")), exit=False
+    )
+    timeout_s = float(os.environ.get("MCPX_SMOKE_TIMEOUT_S", "900"))
+    os.environ["MCPX_BENCH_BATCH"] = str(batch)
+
+    async def go() -> dict | None:
+        from bench import _build_config
+        from mcpx.engine.engine import InferenceEngine
+        from mcpx.planner.grammar import build_plan_grammar
+        from mcpx.utils.synth import synth_registry
+
+        cfg = _build_config("2b")
+        eng = InferenceEngine(cfg)
+        t0 = time.monotonic()
+        try:
+            await asyncio.wait_for(eng.start(), timeout=timeout_s)
+            t_start = time.monotonic() - t0
+            records = synth_registry(1000, seed=0)
+            grammar = build_plan_grammar(
+                eng.tokenizer,
+                [r.name for r in records],
+                input_keys=sorted(
+                    {k for r in records for k in (*r.input_schema, *r.output_schema)}
+                ),
+            )
+            prompt = eng.tokenizer.encode(
+                "Compose a service DAG.\nIntent: fetch auth\nJSON:"
+            )
+            t1 = time.monotonic()
+            res = await asyncio.wait_for(
+                eng.generate(prompt, constrained=True, grammar=grammar),
+                timeout=300,
+            )
+            return {
+                "ok": True,
+                "batch": batch,
+                "startup_s": round(t_start, 1),
+                "first_plan_s": round(time.monotonic() - t1, 1),
+                "text_head": res.text[:60],
+            }
+        except Exception:
+            traceback.print_exc()
+            return None
+        # KeyboardInterrupt/SystemExit propagate: an operator abort must
+        # abort, not read as "this batch failed". No aclose() on the way
+        # out — the process exit releases HBM more reliably than a
+        # cooperative close whose worker may be the thing that's stuck.
+
+    out = asyncio.run(go())
+    if out is None:
+        return 1
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+def main() -> int:
+    if len(sys.argv) == 3 and sys.argv[1] == "--single":
+        return run_single(int(sys.argv[2]))
+    timeout_s = float(os.environ.get("MCPX_SMOKE_TIMEOUT_S", "900"))
+    # The driver owns the TOTAL budget (default 2400s) and sizes each
+    # child's cap from what remains — the session script's outer `timeout`
+    # (2700s) must never fire mid-attempt: a SIGTERM to this driver would
+    # orphan a --single child that still holds the tunnel and HBM, and the
+    # next session step would block silently behind it.
+    deadline = time.monotonic() + float(os.environ.get("MCPX_SMOKE_TOTAL_S", "2400"))
+    batches = [
+        int(b)
+        for b in os.environ.get("MCPX_SMOKE_BATCHES", "64,32").split(",")
+        if b.strip()
+    ]
+    for batch in batches:
+        remaining = deadline - time.monotonic()
+        if remaining < 420:
+            # Not enough time for a plausible bring-up: stop rather than
+            # launch an attempt the budget would kill mid-start (a killed
+            # attempt reads as "batch failed", falsely demoting the session
+            # to model=test).
+            print(
+                f"smoke: {remaining:.0f}s left < 420s floor; skipping "
+                f"batch={batch} and smaller",
+                file=sys.stderr,
+            )
+            break
+        # start watchdog + generate cap + compile/teardown slack, so the
+        # child's own bounded failure paths normally fire first.
+        child_cap = min(timeout_s + 300 + 300, remaining)
+        print(f"smoke: trying 2b batch={batch}", file=sys.stderr, flush=True)
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--single", str(batch)],
+                stdout=subprocess.PIPE,
+                timeout=child_cap,
+            )
+        except subprocess.TimeoutExpired:
+            print(f"smoke: batch={batch} hit driver cap {child_cap:.0f}s", file=sys.stderr)
+            continue
+        tail = [
+            ln
+            for ln in proc.stdout.decode(errors="replace").splitlines()
+            if ln.startswith("{")
+        ]
+        if proc.returncode == 0 and tail:
+            print(tail[-1], flush=True)
+            return 0
+    print(json.dumps({"ok": False, "batches_tried": batches}), flush=True)
+    return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

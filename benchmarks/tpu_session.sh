@@ -1,18 +1,24 @@
 #!/bin/bash
-# One TPU tunnel session, headline first: the axon tunnel admits one client
+# One TPU tunnel session, cheapest-first: the axon tunnel admits one client
 # process at a time (a second blocks silently), so run everything in order
-# from a single shell; each step is timeout-guarded, and artifacts are
-# written to a temp path and moved only on non-empty output — a mid-session
-# wedge never clobbers a previous session's good artifact.
+# from a single shell; each step is timeout-guarded, full stderr goes to
+# per-step logs under benchmarks/logs/ (r5: the 2b startup failure was
+# unobservable through the old `tail -5` stderr filter), and artifacts are
+# written to a temp path and moved only on valid JSON — a mid-session wedge
+# never clobbers a previous session's good artifact.
 #
+#   0. startup_smoke.py    -> benchmarks/smoke_tpu.json   (2b bring-up at
+#      batch 64 -> 32 -> 16; exports MCPX_BENCH_BATCH for the bench steps;
+#      a bring-up that kills the tunnel costs ~20 min here, not the session)
 #   1. bench.py            -> benchmarks/bench_tpu.json  (headline + quality)
-#   2. ladder.py           -> benchmarks/ladder_tpu.json (5 BASELINE configs)
-#   3. engine_probe sweeps -> benchmarks/probe_sweep_tpu.txt (p50 levers:
-#      budget/tick/minfree/spec/depth — pick the p50-optimal into bench.py)
+#   2. honesty rows        -> bench_tpu_{ood,cache,sp}.json
+#   3. ladder.py           -> benchmarks/ladder_tpu.json (5 BASELINE configs)
+#   4. engine_probe sweeps -> benchmarks/probe_sweep_tpu.txt (p50 levers)
 #
 # Usage: bash benchmarks/tpu_session.sh
 set -x
 cd "$(dirname "$0")/.."
+mkdir -p benchmarks/logs
 
 keep_if_nonempty() {  # $1 tmp, $2 dest
   if [ -s "$1" ]; then mv "$1" "$2"; else rm -f "$1"; fi
@@ -26,9 +32,43 @@ keep_if_json() {  # $1 tmp, $2 dest — only complete JSON may replace a good ar
   fi
 }
 
-# grep + json.tool so neither a non-JSON diagnostic nor a timeout-truncated
-# fragment can replace a previous session's good artifact (ADVICE r4).
-timeout 3000 python bench.py 2> >(tail -5 >&2) | grep -E '^\{' | tail -1 > benchmarks/.bench_tpu.tmp
+# ---- 0. 2b bring-up smoke: find the batch size that serves (or fail fast
+# with a full traceback in the log instead of burning the headline step).
+# Gating reads THIS session's output (.smoke_out), never the published
+# artifact — keep_if_json intentionally preserves a previous session's
+# smoke_tpu.json when this one produces nothing, and a stale "ok" must not
+# steer this session's steps.
+timeout 2700 python benchmarks/startup_smoke.py \
+  2> benchmarks/logs/smoke.err | grep -E '^\{' | tail -1 > benchmarks/.smoke_out
+cp benchmarks/.smoke_out benchmarks/.smoke_tpu.tmp
+keep_if_json benchmarks/.smoke_tpu.tmp benchmarks/smoke_tpu.json
+cat benchmarks/.smoke_out
+SMOKE_BATCH=$(python - <<'EOF' 2>/dev/null
+import json
+try:
+    d = json.load(open("benchmarks/.smoke_out"))
+    print(d["batch"] if d.get("ok") else "")
+except Exception:
+    print("")
+EOF
+)
+rm -f benchmarks/.smoke_out
+if [ -n "$SMOKE_BATCH" ]; then
+  export MCPX_BENCH_BATCH="$SMOKE_BATCH"
+  # The probe sweep builds its own engines: give it the proven batch too.
+  export PROBE_BATCH="$SMOKE_BATCH"
+else
+  # 2b proved unservable (or the smoke never completed): a measured
+  # model=test TPU number beats four steps of re-failing 2b bring-up.
+  export MCPX_BENCH_MODEL=test
+  # engine_probe selects via PROBE_MODEL (default 2b), not MCPX_BENCH_MODEL
+  # — without this the sweep step would re-fail the exact bring-up the
+  # smoke fenced off.
+  export PROBE_MODEL=test
+fi
+
+timeout 3000 python bench.py 2> benchmarks/logs/bench.err | grep -E '^\{' | tail -1 > benchmarks/.bench_tpu.tmp
+tail -5 benchmarks/logs/bench.err >&2
 keep_if_json benchmarks/.bench_tpu.tmp benchmarks/bench_tpu.json
 cat benchmarks/bench_tpu.json 2>/dev/null
 
@@ -36,25 +76,25 @@ cat benchmarks/bench_tpu.json 2>/dev/null
 # row, not the headline): OOD registry (unfitted BPE compression), repeat-
 # intent plan-cache lever, SP-vocab real-checkpoint serving configuration.
 MCPX_BENCH_REGISTRY=ood MCPX_BENCH_REQUESTS=256 MCPX_BENCH_LATENCY_REQUESTS=96 MCPX_BENCH_SKIP_QUALITY=1 \
-  timeout 1800 python bench.py 2> >(tail -3 >&2) | grep -E '^\{' | tail -1 > benchmarks/.bench_ood.tmp
+  timeout 1800 python bench.py 2> benchmarks/logs/bench_ood.err | grep -E '^\{' | tail -1 > benchmarks/.bench_ood.tmp
 keep_if_json benchmarks/.bench_ood.tmp benchmarks/bench_tpu_ood.json
 cat benchmarks/bench_tpu_ood.json 2>/dev/null
 
 MCPX_BENCH_UNIQUE_INTENTS=64 MCPX_BENCH_REQUESTS=512 MCPX_BENCH_LATENCY_REQUESTS=96 MCPX_BENCH_SKIP_QUALITY=1 \
-  timeout 1800 python bench.py 2> >(tail -3 >&2) | grep -E '^\{' | tail -1 > benchmarks/.bench_cache.tmp
+  timeout 1800 python bench.py 2> benchmarks/logs/bench_cache.err | grep -E '^\{' | tail -1 > benchmarks/.bench_cache.tmp
 keep_if_json benchmarks/.bench_cache.tmp benchmarks/bench_tpu_cache.json
 cat benchmarks/bench_tpu_cache.json 2>/dev/null
 
 MCPX_BENCH_VOCAB=sp MCPX_BENCH_REQUESTS=256 MCPX_BENCH_LATENCY_REQUESTS=96 MCPX_BENCH_SKIP_QUALITY=1 \
-  timeout 2400 python bench.py 2> >(tail -3 >&2) | grep -E '^\{' | tail -1 > benchmarks/.bench_sp.tmp
+  timeout 2400 python bench.py 2> benchmarks/logs/bench_sp.err | grep -E '^\{' | tail -1 > benchmarks/.bench_sp.tmp
 keep_if_json benchmarks/.bench_sp.tmp benchmarks/bench_tpu_sp.json
 cat benchmarks/bench_tpu_sp.json 2>/dev/null
 
-timeout 3000 python benchmarks/ladder.py 2> >(tail -5 >&2) > benchmarks/.ladder_tpu.tmp
+timeout 3000 python benchmarks/ladder.py 2> benchmarks/logs/ladder.err > benchmarks/.ladder_tpu.tmp
 keep_if_nonempty benchmarks/.ladder_tpu.tmp benchmarks/ladder_tpu.json
 cat benchmarks/ladder_tpu.json 2>/dev/null
 
 PROBE_SWEEP="budget=40;budget=32;budget=48;budget=40,tick=2;budget=40,minfree=1;budget=40,minfree=16;budget=40,spec=4;budget=40,depth=3;budget=40,draft=off;budget=40,tick=1;budget=40,tick=8" \
-  timeout 3500 python benchmarks/engine_probe.py 2>&1 | grep -E '^\{' > benchmarks/.probe_sweep_tpu.tmp
+  timeout 3500 python benchmarks/engine_probe.py 2> benchmarks/logs/probe.err | grep -E '^\{' > benchmarks/.probe_sweep_tpu.tmp
 keep_if_nonempty benchmarks/.probe_sweep_tpu.tmp benchmarks/probe_sweep_tpu.txt
 cat benchmarks/probe_sweep_tpu.txt 2>/dev/null

@@ -218,7 +218,14 @@ def build_app(cp: ControlPlane) -> web.Application:
     async def healthz(request: web.Request) -> web.Response:
         engine = getattr(cp.planner, "engine", None)
         engine_state = getattr(engine, "state", "n/a") if engine is not None else "n/a"
-        return web.json_response({"status": "ok", "engine": engine_state})
+        body: dict[str, Any] = {"status": "ok", "engine": engine_state}
+        # Surface the startup failure cause: a remote operator (or the bench
+        # session log) must be able to see WHY the engine is down without
+        # shell access to the server's stderr — e.g. a device OOM string.
+        err = getattr(engine, "_startup_error", None) if engine is not None else None
+        if err is not None:
+            body["engine_error"] = f"{type(err).__name__}: {err}"
+        return web.json_response(body)
 
     # Device-side profiling (SURVEY.md §5 tracing): capture a jax.profiler
     # trace of live serving (prefill/decode/collectives) for TensorBoard /
