@@ -104,26 +104,28 @@ def main() -> int:
     if len(sys.argv) == 3 and sys.argv[1] == "--single":
         return run_single(int(sys.argv[2]))
     timeout_s = float(os.environ.get("MCPX_SMOKE_TIMEOUT_S", "900"))
-    # The driver owns the TOTAL budget (default 2400s) and sizes each
-    # child's cap from what remains — the session script's outer `timeout`
-    # (2700s) must never fire mid-attempt: a SIGTERM to this driver would
-    # orphan a --single child that still holds the tunnel and HBM, and the
-    # next session step would block silently behind it.
-    deadline = time.monotonic() + float(os.environ.get("MCPX_SMOKE_TOTAL_S", "2400"))
+    # The driver owns the TOTAL budget (default 3300s: two full worst-case
+    # attempts) and sizes each child's cap from what remains — the session
+    # script's outer `timeout` (3600s) must never fire mid-attempt: a
+    # SIGTERM to this driver would orphan a --single child that still holds
+    # the tunnel and HBM, and the next session step would block silently
+    # behind it.
+    deadline = time.monotonic() + float(os.environ.get("MCPX_SMOKE_TOTAL_S", "3300"))
     batches = [
         int(b)
         for b in os.environ.get("MCPX_SMOKE_BATCHES", "64,32").split(",")
         if b.strip()
     ]
+    floor = timeout_s + 60  # a COMPLETE attempt needs the full start watchdog
     for batch in batches:
         remaining = deadline - time.monotonic()
-        if remaining < 420:
-            # Not enough time for a plausible bring-up: stop rather than
+        if remaining < floor:
+            # Not enough time for a complete bring-up: stop rather than
             # launch an attempt the budget would kill mid-start (a killed
             # attempt reads as "batch failed", falsely demoting the session
             # to model=test).
             print(
-                f"smoke: {remaining:.0f}s left < 420s floor; skipping "
+                f"smoke: {remaining:.0f}s left < {floor:.0f}s floor; skipping "
                 f"batch={batch} and smaller",
                 file=sys.stderr,
             )

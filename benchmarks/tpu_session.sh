@@ -8,8 +8,9 @@
 # never clobbers a previous session's good artifact.
 #
 #   0. startup_smoke.py    -> benchmarks/smoke_tpu.json   (2b bring-up at
-#      batch 64 -> 32 -> 16; exports MCPX_BENCH_BATCH for the bench steps;
-#      a bring-up that kills the tunnel costs ~20 min here, not the session)
+#      batch 64 then 32; exports MCPX_BENCH_BATCH for the bench steps;
+#      a bring-up that kills the tunnel costs its own step here, not the
+#      whole session)
 #   1. bench.py            -> benchmarks/bench_tpu.json  (headline + quality)
 #   2. honesty rows        -> bench_tpu_{ood,cache,sp}.json
 #   3. ladder.py           -> benchmarks/ladder_tpu.json (5 BASELINE configs)
@@ -38,7 +39,7 @@ keep_if_json() {  # $1 tmp, $2 dest — only complete JSON may replace a good ar
 # artifact — keep_if_json intentionally preserves a previous session's
 # smoke_tpu.json when this one produces nothing, and a stale "ok" must not
 # steer this session's steps.
-timeout 2700 python benchmarks/startup_smoke.py \
+timeout 3600 python benchmarks/startup_smoke.py \
   2> benchmarks/logs/smoke.err | grep -E '^\{' | tail -1 > benchmarks/.smoke_out
 cp benchmarks/.smoke_out benchmarks/.smoke_tpu.tmp
 keep_if_json benchmarks/.smoke_tpu.tmp benchmarks/smoke_tpu.json

@@ -10,18 +10,29 @@ against the schema vendored by ``transformers``), encodes with the standard
 unigram Viterbi, and can also *write* tiny models for fixtures.
 
 Scope: unigram/BPE inference (piece table + scores), byte-fallback, the
-``add_dummy_prefix``/``escape_whitespaces`` normalizer flags. NFKC
-normalization (``precompiled_charsmap``) is NOT implemented — identifier-
-like planner text is ASCII; when the ``sentencepiece`` package is present
-the tokenizer prefers it (exact parity with the shipped model), this codec
-is the always-available fallback.
+``add_dummy_prefix``/``escape_whitespaces`` normalizer flags, and the
+``nmt_nfkc``/``nmt_nfkc_cf`` normalizers (Gemma ships ``nmt_nfkc``):
+NFKC via ``unicodedata`` plus the NMT control/whitespace rules. Matching
+the real library's semantics, normalization fires only when the model
+SHIPS a non-empty ``precompiled_charsmap`` (inference normalizes via the
+charsmap bytes; an empty charsmap is identity and the name is
+informational) — the declared ``name`` then tells this codec WHICH recipe
+those bytes encode. APPROXIMATION NOTE: the charsmap itself (a frozen
+Unicode snapshot compiled into a double-array trie) is NOT decoded — this
+host Python's Unicode tables stand in for it, which can differ on
+codepoints whose NFKC mapping changed between Unicode versions (none of
+which appear in planner/JSON text). When the ``sentencepiece`` package is
+present the tokenizer prefers it (exact parity with the shipped model);
+this codec is the always-available fallback.
 
 Wire cheat-sheet (all that is needed here):
 
     ModelProto:      1 repeated SentencePiece, 2 TrainerSpec, 3 NormalizerSpec
     SentencePiece:   1 piece (string), 2 score (float32), 3 type (enum)
     TrainerSpec:     40 unk_id, 41 bos_id, 42 eos_id, 43 pad_id (int32)
-    NormalizerSpec:  3 add_dummy_prefix, 5 escape_whitespaces (bool)
+    NormalizerSpec:  1 name (string), 2 precompiled_charsmap (bytes),
+                     3 add_dummy_prefix, 4 remove_extra_whitespaces,
+                     5 escape_whitespaces (bool)
     Type enum:       1 NORMAL, 2 UNKNOWN, 3 CONTROL, 4 USER_DEFINED,
                      5 UNUSED, 6 BYTE
 """
@@ -30,12 +41,43 @@ from __future__ import annotations
 
 import re
 import struct
+import unicodedata
 from dataclasses import dataclass, field
 
 NORMAL, UNKNOWN, CONTROL, USER_DEFINED, UNUSED, BYTE = 1, 2, 3, 4, 5, 6
 
 _WS = "▁"  # ▁ — SentencePiece's escaped space
 _RUNS_RE = re.compile(r"  +")
+
+# NMT normalization rules (applied by the nmt_nfkc* normalizers before
+# NFKC; modeled on the public sentencepiece builder's AddRulesForNMT):
+# controls and zero-width/format marks are dropped; every flavour of
+# horizontal whitespace and the line/paragraph separators become plain
+# spaces (which remove_extra_whitespaces then collapses). One translate()
+# table so the per-encode pass runs in C, not a Python char loop.
+_NMT_TABLE = {
+    # C0 controls minus \t \n \r, DEL, C1 controls minus NEL,
+    **dict.fromkeys(
+        [*range(0x00, 0x09), 0x0B, 0x0C, *range(0x0E, 0x20), 0x7F,
+         *(c for c in range(0x80, 0xA0) if c != 0x85),
+         # soft hyphen, zero-width space/joiners/marks, word joiner, BOM.
+         0x00AD, *range(0x200B, 0x2010), 0x2060, 0xFEFF, 0xFFFE]
+    ),
+    **dict.fromkeys(
+        [0x09, 0x0A, 0x0D, 0x85, 0x00A0, 0x1680, *range(0x2000, 0x200B),
+         0x2028, 0x2029, 0x202F, 0x205F, 0x3000],
+        " ",
+    ),
+}
+
+
+def nmt_nfkc_normalize(text: str, casefold: bool = False) -> str:
+    """``nmt_nfkc`` (and ``_cf``) normalization without the shipped
+    charsmap: NMT control/whitespace cleanup, then ``unicodedata`` NFKC
+    (this Python's Unicode tables stand in for the frozen snapshot the
+    real ``precompiled_charsmap`` encodes), then optional casefold."""
+    text = unicodedata.normalize("NFKC", text.translate(_NMT_TABLE))
+    return text.casefold() if casefold else text
 
 
 # ----------------------------------------------------------------- wire io
@@ -116,6 +158,14 @@ class SPModel:
     add_dummy_prefix: bool = True
     escape_whitespaces: bool = True
     remove_extra_whitespaces: bool = True
+    # NormalizerSpec.name: "nmt_nfkc" (Gemma/most models), "nmt_nfkc_cf"
+    # (+casefold), "nfkc", or "identity". Names WHICH recipe the shipped
+    # charsmap encodes; normalization fires only when a non-empty charsmap
+    # is present (the real library normalizes via the charsmap bytes — an
+    # empty charsmap is identity regardless of name, so a name-less or
+    # charsmap-less model keeps its historical identity behavior).
+    normalizer_name: str = "nmt_nfkc"
+    precompiled_charsmap: bytes = b""
 
     # ------------------------------------------------------------- parsing
     @classmethod
@@ -146,7 +196,11 @@ class SPModel:
                         m.pad_id = _i32(tv)
             elif fn == 3 and wt == 2:  # NormalizerSpec
                 for nfn, nwt, nv in _fields(v):
-                    if nfn == 3 and nwt == 0:
+                    if nfn == 1 and nwt == 2:
+                        m.normalizer_name = nv.decode("utf-8")
+                    elif nfn == 2 and nwt == 2:
+                        m.precompiled_charsmap = bytes(nv)
+                    elif nfn == 3 and nwt == 0:
                         m.add_dummy_prefix = bool(nv)
                     elif nfn == 4 and nwt == 0:
                         m.remove_extra_whitespaces = bool(nv)
@@ -199,7 +253,9 @@ class SPModel:
         )
         out += ld(2, trainer)
         norm = (
-            vi(3, int(self.add_dummy_prefix))
+            ld(1, self.normalizer_name.encode("utf-8"))
+            + (ld(2, self.precompiled_charsmap) if self.precompiled_charsmap else b"")
+            + vi(3, int(self.add_dummy_prefix))
             + vi(4, int(self.remove_extra_whitespaces))
             + vi(5, int(self.escape_whitespaces))
         )
@@ -245,6 +301,19 @@ class UnigramEncoder:
         self._unk_score = min_score - 10.0
 
     def normalize(self, text: str) -> str:
+        name = self.model.normalizer_name
+        if self.model.precompiled_charsmap and "nfkc" in name:
+            # Charsmap present = the model really normalizes (the package
+            # backend normalizes via these bytes; empty = identity even if
+            # the name says otherwise — parity demands the same here).
+            # "nmt_nfkc" / "nfkc" / "nmt_nfkc_cf" — NMT rules only apply to
+            # the nmt_* variants; bare "nfkc" is NFKC alone.
+            if name.startswith("nmt_"):
+                text = nmt_nfkc_normalize(text, casefold=name.endswith("_cf"))
+            else:
+                text = unicodedata.normalize("NFKC", text)
+                if name.endswith("_cf"):
+                    text = text.casefold()
         if self.model.remove_extra_whitespaces:
             # Proto-default normalization: collapse space runs, strip ends.
             text = _RUNS_RE.sub(" ", text).strip(" ")

@@ -96,6 +96,54 @@ def test_normalizer_flags_match_real_model_defaults():
     assert m2.add_dummy_prefix and m2.remove_extra_whitespaces
 
 
+def test_nmt_nfkc_normalization_applies_when_declared():
+    """A model declaring nmt_nfkc (what Gemma ships) normalizes non-ASCII
+    intents before segmentation: compatibility forms fold to their ASCII
+    equivalents, exotic whitespace becomes plain spaces, zero-width marks
+    vanish, and the _cf variant casefolds — so a real .model served through
+    the in-tree codec no longer silently diverges from reference
+    tokenization on non-ASCII text (VERDICT r4 missing #3)."""
+    m = tiny_model()
+    m.normalizer_name = "nmt_nfkc"
+    # Normalization is armed by a NON-EMPTY charsmap (the real library
+    # normalizes via the charsmap bytes; empty = identity regardless of
+    # name — the in-tree codec mirrors that so the two backends cannot
+    # diverge on charsmap-less fixture models).
+    m.precompiled_charsmap = b"\x01"
+    enc = UnigramEncoder(m)
+    # NFKC compatibility folds: ligature fi, fullwidth letters, circled 1.
+    assert enc.encode("ﬁrst") == enc.encode("first")
+    assert enc.encode("ｆｅｔｃｈ") == enc.encode("fetch")
+    assert enc.encode("①0") == enc.encode("10")
+    # NMT rules: tab/CR/NBSP -> space (then collapsed), zero-width dropped.
+    assert enc.encode("fetch\t then\r") == enc.encode("fetch then")
+    assert enc.encode("fe​tch﻿") == enc.encode("fetch")
+    # Casefold only on the _cf variant.
+    m_cf = tiny_model()
+    m_cf.normalizer_name = "nmt_nfkc_cf"
+    m_cf.precompiled_charsmap = b"\x01"
+    assert UnigramEncoder(m_cf).encode("FETCH") == enc.encode("fetch")
+    assert enc.encode("FETCH") != enc.encode("fetch")
+    # identity models — and nfkc-named models WITHOUT a charsmap (what
+    # tiny_model/dumps historically produced, and what the package backend
+    # treats as identity) — are untouched.
+    m_id = tiny_model()
+    m_id.normalizer_name = "identity"
+    assert UnigramEncoder(m_id).encode("ﬁrst") != UnigramEncoder(m_id).encode(
+        "first"
+    )
+    m_nomap = tiny_model()
+    m_nomap.normalizer_name = "nmt_nfkc"
+    assert UnigramEncoder(m_nomap).encode("ﬁrst") != UnigramEncoder(
+        m_nomap
+    ).encode("first")
+    # The declared name and charsmap survive the wire round trip.
+    m2 = SPModel.loads(m.dumps())
+    assert m2.normalizer_name == "nmt_nfkc"
+    assert m2.precompiled_charsmap == b"\x01"
+    assert SPModel.loads(m_cf.dumps()).normalizer_name == "nmt_nfkc_cf"
+
+
 def test_tokenizer_round_trip_and_token_bytes_contract(sp_path):
     tok = make_tokenizer(f"sp:{sp_path}")
     assert isinstance(tok, SentencePieceTokenizer)
