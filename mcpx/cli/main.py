@@ -155,6 +155,7 @@ def cmd_eval_planner(args: argparse.Namespace) -> int:
             registry_seed=args.registry_seed,
             n_intents=args.intents,
             seed=args.seed,
+            constrain_names=args.constrain_names,
         )
     )
     print(json.dumps({k: round(v, 4) if isinstance(v, float) else v for k, v in out.items()}))
@@ -213,6 +214,10 @@ def main(argv: list[str] | None = None) -> int:
     p_eval.add_argument("--registry-seed", type=int, default=0)
     p_eval.add_argument("--intents", type=int, default=48)
     p_eval.add_argument("--seed", type=int, default=1234)
+    p_eval.add_argument("--constrain-names", choices=["registry", "shortlist"],
+                        default="registry",
+                        help="grammar tier: registry-wide name trie (serving "
+                        "default) or shortlist-only (tightest constraint)")
     p_eval.add_argument("--platform", choices=["cpu", "auto"], default="auto",
                         help="cpu: pin to host CPU (never dials the TPU "
                         "tunnel); auto (default): whatever jax picks")

@@ -232,6 +232,14 @@ class PlannerConfig:
     # (free-string keys sample every character). Set "off" if callers pass
     # payload keys outside any schema.
     constrain_input_keys: str = "registry"
+    # Typed-dataflow grammar for the "shortlist" tier: each step's "in"
+    # list accepts only the named service's own input keys and its "next"
+    # list only services one of its outputs feeds — incoherent edges stop
+    # being REPRESENTABLE at decode time (grammar.py typed construction).
+    # Only applies when constrain_names="shortlist" (per-service step
+    # bodies multiply grammar states by the candidate count; a
+    # registry-wide typed grammar would trip the table budget).
+    constrain_dataflow: bool = True
     # Drop LLM-emitted edges a->b where no output key of a's service is an
     # input key of b's service (per the registry's schemas) — after the
     # planner has rewired the keys that DO overlap to read a's result

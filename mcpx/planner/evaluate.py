@@ -22,12 +22,18 @@ async def evaluate_planner(
     seed: int = 1234,
     shortlist_top_k: int = 6,
     use_pallas: Optional[bool] = None,
+    constrain_names: str = "registry",
 ) -> dict:
     """Serve ``checkpoint`` through the real control plane (engine +
     retrieval shortlist + grammar-constrained decode) against a synthetic
     registry and return mean plan-quality + ``llm_share``. ``use_pallas``
     defaults to whether a non-CPU backend is live (a pinned 2b on a CPU
-    host must not lower Mosaic TPU kernels)."""
+    host must not lower Mosaic TPU kernels). ``constrain_names`` picks the
+    serving grammar tier: "registry" (default — one trie over all names,
+    best batching) or "shortlist" (trie over only the prompt's shortlist —
+    the tightest constraint; a tiny model that drifts to on-topic but
+    non-shortlist names is forced back onto the prompt's candidates, at
+    the serving cost of per-shortlist grammars splitting decode batches)."""
     import jax
 
     from mcpx.core.config import MCPXConfig, PlannerConfig
@@ -47,9 +53,15 @@ async def evaluate_planner(
                 "checkpoint_path": checkpoint,
             },
             "engine": {
-                # The training corpus geometry (models/corpus.py).
+                # The training corpus geometry (models/corpus.py): 128-token
+                # prompt budget + 64-token target budget (seq_len 192).
+                # Serving with less than the corpus's decode budget CLIPS the
+                # model: ~70% of teacher-grade plans run past 40 tokens
+                # (measured: mean 42.6, p99 53), and the grammar's
+                # distance-to-accept steering then closes plans early —
+                # silently costing coverage and edges, not failing loudly.
                 "max_batch_size": 16,
-                "max_decode_len": 40,
+                "max_decode_len": 64,
                 "kv_page_size": 64,
                 "max_pages_per_seq": 4,
                 "temperature": 0.0,
@@ -60,6 +72,7 @@ async def evaluate_planner(
                 "kind": "llm",
                 "max_plan_retries": 0,
                 "shortlist_top_k": shortlist_top_k,
+                "constrain_names": constrain_names,
                 # Eval measures the MODEL's raw emissions: serving-path
                 # normalization (dataflow rewiring/pruning) would mask
                 # imitation errors — pruning a model's bad edge must show

@@ -309,16 +309,21 @@ class LLMPlanner:
             return None
         if mode == "shortlist" and context.shortlist:
             names = [n for n in context.shortlist if n not in context.exclude]
-            key = (version, tuple(names))
+            # Mode discriminator: a shortlist ('x','y') and an exclude set
+            # {'x','y'} at the same version must NOT share a cache slot —
+            # the collision would serve a trie admitting ONLY the excluded
+            # names to the very replan that must avoid them.
+            key = ("short", version, tuple(names))
         else:
             # Excluded (replanned-around) services must leave the TRIE, not
             # just the resolution map: a greedy decode would otherwise
             # deterministically re-emit the excluded name on every retry and
             # fall back to the heuristic exactly when a replan matters most.
             names = [s.name for s in all_services if s.name not in context.exclude]
-            key = (version, tuple(sorted(context.exclude)) or None)
+            key = ("excl", version, tuple(sorted(context.exclude)) or None)
         if not names:
             return None
+        typed = mode == "shortlist" and self.config.constrain_dataflow
         cached = self._grammar_cache.get(key)
         if cached is not None:
             self._grammar_cache.move_to_end(key)
@@ -328,7 +333,7 @@ class LLMPlanner:
             if cached is not None:
                 return cached if cached is not _SHAPE_ONLY else None
             grammar = await asyncio.to_thread(
-                self._build_grammar, names, all_services, version
+                self._build_grammar, names, all_services, version, typed
             )
             # A failed (shape-only) outcome is cached too: at the registry
             # sizes where the build fails, the failing attempts themselves
@@ -341,17 +346,21 @@ class LLMPlanner:
                 self._grammar_cache.popitem(last=False)
             return grammar
 
-    def _build_grammar(self, names, all_services, version=None):
+    def _build_grammar(self, names, all_services, version=None, typed=False):
         """Tightest grammar that compiles within budget for this tokenizer.
-        With ``constrain_input_keys="registry"`` (default) the "in" key
+        With ``typed`` (shortlist tier + ``constrain_dataflow``), the first
+        attempt is the typed-dataflow grammar: per-service step bodies whose
+        "in"/"next" positions admit only schema-valid keys/successors —
+        incoherent edges are unrepresentable. With
+        ``constrain_input_keys="registry"`` (default) the "in" key
         positions are trie'd over the union of the registry's schema keys —
         better plans (only keys some service produces/consumes are
         representable), compact tables on big subword vocabs (free strings
         would make most of the vocab active, VERDICT r2 #4), and roughly 2x
         speculation fast-forward (trie'd key characters are mostly FORCED).
-        Fallback ladder on ValueError: with-keys -> without-keys (byte-vocab
-        dense always fits) -> shape-only (None -> the engine's generic
-        grammar)."""
+        Fallback ladder on ValueError: typed -> with-keys -> without-keys
+        (byte-vocab dense always fits) -> shape-only (None -> the engine's
+        generic grammar)."""
         keys: list[str] = []
         if self.config.constrain_input_keys == "registry":
             keys = sorted(
@@ -361,17 +370,42 @@ class LLMPlanner:
                     for k in (*s.input_schema.keys(), *s.output_schema.keys())
                 }
             )
-        attempts = []
+        name_set = set(names)
+        records = [s for s in all_services if s.name in name_set]
+        # 24: per-service bodies multiply states by the candidate count —
+        # far past any shortlist width, far under registry scale.
+        do_typed = typed and records and len(records) <= 24
+        attempts: list[tuple[str, object]] = []
+        if do_typed:
+            attempts.append(("typed", records))
         if keys:
-            attempts.append(keys)
-        attempts.append(None)
+            attempts.append(("keys", keys))
+        attempts.append(("free", None))
         last_err: Exception | None = None
-        for input_keys in attempts:
+        typed_err: Exception | None = None
+        for kind, arg in attempts:
             try:
-                g = build_plan_grammar(
-                    self.engine.tokenizer, names, input_keys=input_keys
-                )
-                if input_keys is None and keys:
+                if kind == "typed":
+                    g = build_plan_grammar(self.engine.tokenizer, services=arg)
+                else:
+                    g = build_plan_grammar(
+                        self.engine.tokenizer, names, input_keys=arg
+                    )
+                if kind != "typed" and do_typed:
+                    # Typed grammar didn't compile for this tokenizer: the
+                    # dataflow guarantee is OFF for this shortlist — count
+                    # it like any other grammar degradation. typed_err, not
+                    # last_err: a failed keys attempt in between must not
+                    # masquerade as the typed failure reason.
+                    log.warning(
+                        "grammar: typed-dataflow build failed (%s); serving "
+                        "untyped %s grammar for registry version %s",
+                        typed_err, kind, version,
+                    )
+                    self.engine.metrics.grammar_fallbacks.labels(
+                        kind="typed_off"
+                    ).inc()
+                if kind == "free" and keys:
                     # Operator asked for key tries but they didn't fit: the
                     # ~2x speculation win and key validation are OFF for
                     # this registry version — say so, don't degrade mutely.
@@ -386,6 +420,8 @@ class LLMPlanner:
                 return g
             except ValueError as e:
                 last_err = e
+                if kind == "typed":
+                    typed_err = e
                 continue
         log.warning(
             "registry grammar not compilable (%s); using shape-only grammar",

@@ -301,6 +301,92 @@ def test_trie_accepts_only_listed_names():
     assert g.is_accept(g.walk('{"steps":[{"s":"billing","in":["anything at all"],"next":[]}]}'))
 
 
+def test_typed_grammar_only_admits_schema_valid_bodies():
+    """Typed-dataflow construction: each step's body is conditioned on the
+    service its "s" named — "in" admits only that service's own input keys,
+    "next" only services one of its outputs feeds (no self). Incoherent
+    edges are UNREPRESENTABLE, extending the registry-name guarantee to
+    dataflow validity (the shortlist serving tier's grammar)."""
+    from mcpx.registry.base import ServiceRecord
+
+    recs = [
+        ServiceRecord(
+            name="fetch",
+            endpoint="local://fetch",
+            input_schema={"query": "str"},
+            output_schema={"data": "str"},
+        ),
+        ServiceRecord(
+            name="summarize",
+            endpoint="local://sum",
+            input_schema={"data": "str"},
+            output_schema={"summary": "str"},
+        ),
+        ServiceRecord(
+            name="audit",
+            endpoint="local://audit",
+            input_schema={"report": "str"},
+            output_schema={},
+        ),
+    ]
+    g = build_plan_grammar(ByteTokenizer(), services=recs)
+    assert g.service_names == tuple(sorted(r.name for r in recs))
+    # Schema-valid: fetch(data) -> summarize(data->summary); own keys only.
+    ok = (
+        '{"steps":[{"s":"fetch","in":["query"],"next":["summarize"]},'
+        '{"s":"summarize","in":["data"],"next":[]}]}'
+    )
+    assert g.is_accept(g.walk(ok))
+    # fetch's outputs feed NO input of audit: the edge is unrepresentable.
+    assert g.walk('{"steps":[{"s":"fetch","in":[],"next":["audit"]}]}') == g.dead_state
+    # "in" is typed per-service: fetch has no "data" input.
+    assert g.walk('{"steps":[{"s":"fetch","in":["data"],"next":[]}]}') == g.dead_state
+    # No self-edges, even when schemas would chain.
+    assert g.walk('{"steps":[{"s":"fetch","in":[],"next":["fetch"]}]}') == g.dead_state
+    # audit produces nothing -> its "next" can only be the empty list.
+    assert g.is_accept(g.walk('{"steps":[{"s":"audit","in":["report"],"next":[]}]}'))
+    assert (
+        g.walk('{"steps":[{"s":"audit","in":["report"],"next":["fetch"]}]}')
+        == g.dead_state
+    )
+    # Empty "in" stays legal everywhere (payload-only steps).
+    assert g.is_accept(g.walk('{"steps":[{"s":"summarize","in":[],"next":[]}]}'))
+
+
+def test_typed_grammar_greedy_walks_stay_schema_valid():
+    """Every token-greedy path through the typed tables decodes to a plan
+    whose edges ALL typecheck — the structural claim the shortlist tier's
+    coherence rests on."""
+    import json as _json
+    import random
+
+    from mcpx.registry.base import ServiceRecord
+    from mcpx.utils.synth import synth_registry
+
+    recs = synth_registry(6, seed=3)
+    by_name = {r.name: r for r in recs}
+    g = build_plan_grammar(ByteTokenizer(), services=recs)
+    rng = random.Random(0)
+    for _ in range(25):
+        state, out = g.start_state, []
+        for _step in range(220):
+            legal = [c for c in range(g.cmask.shape[1]) if g.cmask[state, c]]
+            col = rng.choice(legal)
+            if g.eos_cols[col]:
+                break
+            out.append(int(g.active_ids[col]))
+            state = int(g.ctrans[state, col])
+        else:
+            continue  # walk didn't terminate: skip (budget tests cover it)
+        obj = _json.loads(ByteTokenizer().decode(out))
+        for step in obj["steps"]:
+            src = by_name[step["s"]]
+            assert set(step["in"]) <= set(src.input_schema)
+            for nxt in step["next"]:
+                assert set(src.output_schema) & set(by_name[nxt].input_schema)
+                assert nxt != step["s"]
+
+
 def test_trie_prefix_name_branches_on_quote():
     g = build_plan_grammar(ByteTokenizer(), ["auth", "auth-fetch"])
     assert g.is_accept(g.walk('{"steps":[{"s":"auth","in":[],"next":["auth-fetch"]}]}'))
