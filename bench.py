@@ -199,8 +199,12 @@ def _build_config(model_size: str):
                 # MCPX_BENCH_MODEL=2b (head_dim 256 passes the Pallas
                 # alignment check) must not run Mosaic TPU kernels on the
                 # CPU backend — the CPU path serves the fused-jnp
-                # reference attention instead.
-                "use_pallas": _on_tpu(),
+                # reference attention instead. MCPX_BENCH_PALLAS=0 forces
+                # the fused-jnp path ON TPU too: the r5 session's 2b
+                # startup RuntimeError is unattributed between HBM OOM and
+                # a first-ever hardware Mosaic compile of the paged kernel,
+                # and the smoke ladder uses this knob to tell them apart.
+                "use_pallas": _pallas_on(),
                 # Compile every (A, T) bucket before serving: the timed
                 # region must contain zero XLA compiles. MCPX_BENCH_WARMUP=0
                 # skips it for CPU smoke runs (a virtual-CPU fallback pays
@@ -303,7 +307,7 @@ async def _run_quality_trained(
         registry_size=registry_size,
         registry_seed=registry_seed,
         n_intents=n_intents,
-        use_pallas=_on_tpu(),
+        use_pallas=_pallas_on(),
     )
     out["registry_size"] = registry_size
     out["registry_seed"] = registry_seed
@@ -327,7 +331,7 @@ async def _run_quality_trained(
                 registry_size=registry_size,
                 registry_seed=registry_seed,
                 n_intents=n_intents,
-                use_pallas=_on_tpu(),
+                use_pallas=_pallas_on(),
                 constrain_names="shortlist",
             ),
             timeout=tier2,
@@ -606,6 +610,12 @@ async def _run(model_size: str, n_requests: int, concurrency: int, n_services: i
             "decode": _hist_p50(prom1, "mcpx_engine_decode_seconds", prom0),
         },
     }
+
+
+def _pallas_on() -> bool:
+    """Pallas only on TPU, and only unless the smoke ladder proved this
+    session must serve the fused-jnp path (MCPX_BENCH_PALLAS=0)."""
+    return _on_tpu() and os.environ.get("MCPX_BENCH_PALLAS", "1") != "0"
 
 
 def _on_tpu() -> bool:

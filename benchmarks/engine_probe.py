@@ -29,10 +29,14 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from bench import _pallas_on
+
 if int(os.environ.get("PROBE_CPU", "0")) > 0:
     from __graft_entry__ import _force_virtual_cpu
 
     _force_virtual_cpu(int(os.environ["PROBE_CPU"]))
+
+
 
 _COUNTERS = (
     ("fwd", "decode_forwards"),
@@ -65,7 +69,10 @@ async def run_one(*, model: str, n_req: int, batch: int, tick: int, spec: int,
                 "kv_page_size": 64,
                 "max_pages_per_seq": 16,
                 "temperature": 0.0,
-                "use_pallas": True,
+                # One definition of the session-wide Pallas gate (tpu AND
+                # MCPX_BENCH_PALLAS != "0"); the cpu-backend clear below
+                # stays for PROBE_CPU virtual-device runs.
+                "use_pallas": _pallas_on(),
                 # The explicit warm rounds below compile exactly the buckets
                 # the probe exercises; full warmup would compile all of them.
                 "warmup_compile": False,

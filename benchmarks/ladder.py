@@ -31,6 +31,8 @@ import time
 # Runnable as `python benchmarks/ladder.py` from the repo root.
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from bench import _pallas_on
+
 if int(os.environ.get("MCPX_LADDER_CPU", "0")) > 0:
     # Arm an N-device virtual CPU platform through the shared recipe — env
     # vars alone cannot evict the latched TPU backend, and the TPU tunnel
@@ -44,6 +46,8 @@ def _on_tpu() -> bool:
     import jax
 
     return jax.default_backend() not in ("cpu",)
+
+
 
 
 def _config(model_size: str, max_batch: int = 32, checkpoint: str = "",
@@ -63,7 +67,11 @@ def _config(model_size: str, max_batch: int = 32, checkpoint: str = "",
                 "kv_page_size": 64,
                 "max_pages_per_seq": 6,
                 "temperature": 0.0,
-                "use_pallas": _on_tpu(),
+                # bench._pallas_on: TPU backend AND the session-wide
+                # MCPX_BENCH_PALLAS gate (tpu_session.sh sets =0 when the
+                # smoke only served with the Pallas kernel off) — one
+                # definition of the knob, not a re-parse per script.
+                "use_pallas": _pallas_on(),
                 "warmup_compile": _on_tpu(),
             },
             "planner": {"kind": "llm", "max_plan_retries": 0,

@@ -1,0 +1,34 @@
+#!/bin/bash
+# Poll the axon tunnel on a 5-minute cadence; on the first ALIVE probe run
+# one full TPU session (benchmarks/tpu_session.sh), then keep polling —
+# the relay has recovered hours after a wedge before (r3->r4), so a failed
+# session is not a reason to stop. The loop exits only once the headline
+# artifact (benchmarks/bench_tpu.json) carries a non-CPU backend, i.e. a
+# real TPU number has landed.
+cd "$(dirname "$0")/.."
+LOG=benchmarks/tunnel_probe_r5.log
+while true; do
+  ts=$(date -u +%FT%T)
+  if python benchmarks/tunnel_probe.py 75 > /dev/null 2>&1; then
+    echo "$ts ALIVE -> launching tpu_session" >> "$LOG"
+    bash benchmarks/tpu_session.sh >> benchmarks/tpu_session_r5.log 2>&1
+    echo "$(date -u +%FT%T) session-done" >> "$LOG"
+    if python - <<'EOF'
+import json, sys
+try:
+    d = json.load(open("benchmarks/bench_tpu.json"))
+except Exception:
+    sys.exit(1)
+# Only a 2b TPU number ends the hunt: a model=test demotion means the smoke
+# ladder (which now includes the no-Pallas tier) should get another window.
+sys.exit(0 if d.get("backend") not in (None, "cpu") and d.get("model") == "2b" else 1)
+EOF
+    then
+      echo "$(date -u +%FT%T) tpu-number-landed; loop exiting" >> "$LOG"
+      exit 0
+    fi
+  else
+    echo "$ts no-listener" >> "$LOG"
+  fi
+  sleep 300
+done

@@ -15,8 +15,10 @@ Two modes:
                    one constrained generate through the registry grammar,
                    aclose(); print one JSON line; exit 0 on success.
 
-  (no args)        (driver) run `--single B` for each B in
-                   MCPX_SMOKE_BATCHES (default "64,32") as a SUBPROCESS —
+  (no args)        (driver) run `--single B` for each spec B in
+                   MCPX_SMOKE_BATCHES (default "64,32,32np"; "np" = Pallas
+                   kernel off, serving the fused-jnp attention) as a
+                   SUBPROCESS —
                    a failed or wedged attempt's HBM (and any stuck worker
                    thread) dies with its process instead of poisoning the
                    next attempt with RESOURCE_EXHAUSTED it didn't earn.
@@ -39,7 +41,18 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def run_single(batch: int) -> int:
+def _parse_spec(spec: str) -> tuple[int, bool]:
+    """"64" -> (64, pallas on); "32np" -> (32, pallas off). The np tier
+    exists because the r5 startup RuntimeError is unattributed between HBM
+    pressure (batch-dependent) and the first-ever hardware Mosaic compile
+    of the paged-attention kernel (batch-independent) — a ladder over
+    batches alone cannot distinguish them."""
+    if spec.endswith("np"):
+        return int(spec[:-2]), False
+    return int(spec), True
+
+
+def run_single(spec: str) -> int:
     import asyncio
     import faulthandler
     import traceback
@@ -48,7 +61,12 @@ def run_single(batch: int) -> int:
         float(os.environ.get("MCPX_SMOKE_HANG_DUMP_S", "1100")), exit=False
     )
     timeout_s = float(os.environ.get("MCPX_SMOKE_TIMEOUT_S", "900"))
+    batch, pallas = _parse_spec(spec)
     os.environ["MCPX_BENCH_BATCH"] = str(batch)
+    # Pin explicitly BOTH ways: an inherited MCPX_BENCH_PALLAS=0 from the
+    # operator's shell must not make a pallas-on spec silently serve the
+    # fused-jnp path while reporting "pallas": true.
+    os.environ["MCPX_BENCH_PALLAS"] = "1" if pallas else "0"
 
     async def go() -> dict | None:
         from bench import _build_config
@@ -81,6 +99,7 @@ def run_single(batch: int) -> int:
             return {
                 "ok": True,
                 "batch": batch,
+                "pallas": pallas,
                 "startup_s": round(t_start, 1),
                 "first_plan_s": round(time.monotonic() - t1, 1),
                 "text_head": res.text[:60],
@@ -102,18 +121,23 @@ def run_single(batch: int) -> int:
 
 def main() -> int:
     if len(sys.argv) == 3 and sys.argv[1] == "--single":
-        return run_single(int(sys.argv[2]))
+        return run_single(sys.argv[2])
     timeout_s = float(os.environ.get("MCPX_SMOKE_TIMEOUT_S", "900"))
-    # The driver owns the TOTAL budget (default 3300s: two full worst-case
-    # attempts) and sizes each child's cap from what remains — the session
-    # script's outer `timeout` (3600s) must never fire mid-attempt: a
-    # SIGTERM to this driver would orphan a --single child that still holds
-    # the tunnel and HBM, and the next session step would block silently
-    # behind it.
-    deadline = time.monotonic() + float(os.environ.get("MCPX_SMOKE_TOTAL_S", "3300"))
+    # The driver owns the TOTAL budget (default 5100s: THREE full worst-case
+    # attempts at the 1500s child cap — the default ladder is three tiers,
+    # and the 32np Mosaic-attribution tier matters most precisely when the
+    # earlier attempts wedge, so the budget must reach it) and sizes each
+    # child's cap from what remains — the session script's outer `timeout`
+    # (5400s) must never fire mid-attempt: a SIGTERM to this driver would
+    # orphan a --single child that still holds the tunnel and HBM, and the
+    # next session step would block silently behind it.
+    deadline = time.monotonic() + float(os.environ.get("MCPX_SMOKE_TOTAL_S", "5100"))
+    # Ladder: full config, then halve the batch (HBM hypothesis), then the
+    # same small batch without the Pallas kernel (Mosaic hypothesis). A
+    # 32np success where 32 failed pins the failure on the kernel.
     batches = [
-        int(b)
-        for b in os.environ.get("MCPX_SMOKE_BATCHES", "64,32").split(",")
+        b.strip()
+        for b in os.environ.get("MCPX_SMOKE_BATCHES", "64,32,32np").split(",")
         if b.strip()
     ]
     floor = timeout_s + 60  # a COMPLETE attempt needs the full start watchdog

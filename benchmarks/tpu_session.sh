@@ -39,7 +39,13 @@ keep_if_json() {  # $1 tmp, $2 dest — only complete JSON may replace a good ar
 # artifact — keep_if_json intentionally preserves a previous session's
 # smoke_tpu.json when this one produces nothing, and a stale "ok" must not
 # steer this session's steps.
-timeout 3600 python benchmarks/startup_smoke.py \
+# Budget covers THREE worst-case wedged attempts (64, 32, 32np at the
+# 1500s child cap each) + floor slack: the 32np Mosaic-attribution tier
+# matters most precisely when the earlier attempts wedge, so it must not
+# be the one the budget starves. Outer timeout stays clear of the driver's
+# own deadline so it never SIGTERMs mid-attempt.
+export MCPX_SMOKE_TOTAL_S="${MCPX_SMOKE_TOTAL_S:-5100}"
+timeout 5400 python benchmarks/startup_smoke.py \
   2> benchmarks/logs/smoke.err | grep -E '^\{' | tail -1 > benchmarks/.smoke_out
 cp benchmarks/.smoke_out benchmarks/.smoke_tpu.tmp
 keep_if_json benchmarks/.smoke_tpu.tmp benchmarks/smoke_tpu.json
@@ -53,11 +59,30 @@ except Exception:
     print("")
 EOF
 )
+SMOKE_PALLAS=$(python - <<'EOF' 2>/dev/null
+import json
+try:
+    d = json.load(open("benchmarks/.smoke_out"))
+    print("" if (not d.get("ok")) or d.get("pallas", True) else "0")
+except Exception:
+    print("")
+EOF
+)
 rm -f benchmarks/.smoke_out
 if [ -n "$SMOKE_BATCH" ]; then
   export MCPX_BENCH_BATCH="$SMOKE_BATCH"
   # The probe sweep builds its own engines: give it the proven batch too.
   export PROBE_BATCH="$SMOKE_BATCH"
+  if [ "$SMOKE_PALLAS" = "0" ]; then
+    # The smoke only served with the Pallas kernel off (Mosaic hypothesis
+    # confirmed): every downstream step must serve the same fused-jnp path.
+    export MCPX_BENCH_PALLAS=0
+  else
+    # Pin the other way too: a stale =0 inherited from the launching shell
+    # (e.g. a prior Mosaic-debug run) must not flip the downstream steps to
+    # fused-jnp while smoke_tpu.json records the Pallas kernel as proven.
+    export MCPX_BENCH_PALLAS=1
+  fi
 else
   # 2b proved unservable (or the smoke never completed): a measured
   # model=test TPU number beats four steps of re-failing 2b bring-up.
@@ -68,7 +93,12 @@ else
   export PROBE_MODEL=test
 fi
 
-timeout 3000 python bench.py 2> benchmarks/logs/bench.err | grep -E '^\{' | tail -1 > benchmarks/.bench_tpu.tmp
+# Quality rows are backend-independent (CPU-pinned evals, measured every
+# round); bound them well inside this step's timeout so a wedged quality
+# phase can never burn the step budget and discard the measured THROUGHPUT
+# headline — the one number only a TPU session can produce.
+MCPX_BENCH_QUALITY_TIMEOUT_S=900 \
+  timeout 3000 python bench.py 2> benchmarks/logs/bench.err | grep -E '^\{' | tail -1 > benchmarks/.bench_tpu.tmp
 tail -5 benchmarks/logs/bench.err >&2
 keep_if_json benchmarks/.bench_tpu.tmp benchmarks/bench_tpu.json
 cat benchmarks/bench_tpu.json 2>/dev/null

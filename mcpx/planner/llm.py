@@ -323,7 +323,18 @@ class LLMPlanner:
             key = ("excl", version, tuple(sorted(context.exclude)) or None)
         if not names:
             return None
-        typed = mode == "shortlist" and self.config.constrain_dataflow
+        # Typed dataflow is a SHORTLIST-tier feature (config.py: "only
+        # applies when constrain_names='shortlist'"): the registry-wide
+        # else-branch above (empty shortlist, or the replan/exclusion tier)
+        # must neither request it (a ~1000-service registry would spam the
+        # typed_off gate metric) nor get it (a <=24-service registry would
+        # silently serve a typed grammar to the replan tier, changing its
+        # semantics).
+        typed = (
+            mode == "shortlist"
+            and bool(context.shortlist)
+            and self.config.constrain_dataflow
+        )
         cached = self._grammar_cache.get(key)
         if cached is not None:
             self._grammar_cache.move_to_end(key)
@@ -375,6 +386,20 @@ class LLMPlanner:
         # 24: per-service bodies multiply states by the candidate count —
         # far past any shortlist width, far under registry scale.
         do_typed = typed and records and len(records) <= 24
+        if typed and not do_typed:
+            # Typed dataflow was REQUESTED but the size gate disabled it
+            # (shortlist wider than 24, or no records matched): the
+            # operator must not read constrain_dataflow=True + zero
+            # fallbacks as "coherence is structurally guaranteed" while
+            # every served grammar is untyped. Same observability contract
+            # as a failed typed build below.
+            log.warning(
+                "grammar: typed-dataflow disabled by size gate (%d candidate "
+                "services, gate 24); serving untyped grammar for registry "
+                "version %s",
+                len(records), version,
+            )
+            self.engine.metrics.grammar_fallbacks.labels(kind="typed_off").inc()
         attempts: list[tuple[str, object]] = []
         if do_typed:
             attempts.append(("typed", records))

@@ -444,3 +444,49 @@ def test_token_exact_clamp_packs_subword_prompts():
         assert "fetch the catalog things" in prompt
 
     asyncio.run(go())
+
+
+def test_typed_dataflow_size_gate_is_observable():
+    """constrain_dataflow=True with a shortlist wider than the 24-service
+    typed gate must NOT silently serve an untyped grammar: the typed_off
+    fallback counter and a warning record that the dataflow guarantee is
+    off (same observability contract as a failed typed build)."""
+
+    async def go():
+        from mcpx.telemetry.metrics import Metrics
+
+        reg = InMemoryRegistry()
+        for i in range(30):
+            await reg.put(
+                ServiceRecord(
+                    name=f"svc-{i:04d}",
+                    endpoint=f"http://x/{i}",
+                    input_schema={"query": "str"},
+                    output_schema={"status": "str"},
+                )
+            )
+        from mcpx.registry.base import stable_snapshot
+
+        version, services = await stable_snapshot(reg)
+        eng = FakeEngine([])
+        eng.metrics = Metrics()
+        p = LLMPlanner(eng, PlannerConfig(kind="llm", constrain_names="shortlist"))
+
+        def typed_off():
+            return eng.metrics.grammar_fallbacks.labels(kind="typed_off")._value.get()
+
+        before = typed_off()
+        g = p._build_grammar(
+            [s.name for s in services], services, version=version, typed=True
+        )
+        assert g is not None
+        assert typed_off() == before + 1
+
+        # Within the gate: no typed_off increment.
+        g2 = p._build_grammar(
+            [s.name for s in services[:8]], services[:8], version=version, typed=True
+        )
+        assert g2 is not None
+        assert typed_off() == before + 1
+
+    asyncio.run(go())
