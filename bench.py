@@ -590,19 +590,14 @@ async def _run(model_size: str, n_requests: int, concurrency: int, n_services: i
         # Absolute end-of-run totals (prom_end, not prom1): builds happen at
         # warmup (before prom0) and in the quality sample (after prom1) too,
         # and a degraded build ANYWHERE in the run taints what was served.
+        # Kinds enumerated dynamically so a new degradation kind (e.g. the
+        # typed_off size-gate) can never be minted in the planner yet stay
+        # invisible in the one JSON line the operator reads; the canonical
+        # kinds are pre-seeded so "zero fallbacks" is an explicit 0, not an
+        # absent key.
         "grammar_fallback": {
-            "shape_only": sum(
-                v
-                for k, v in prom_end.items()
-                if k.startswith("mcpx_grammar_fallbacks_total")
-                and 'kind="shape_only"' in k
-            ),
-            "keys_free": sum(
-                v
-                for k, v in prom_end.items()
-                if k.startswith("mcpx_grammar_fallbacks_total")
-                and 'kind="keys_free"' in k
-            ),
+            **{k: 0 for k in ("shape_only", "keys_free", "typed_off")},
+            **_fallback_kinds(prom_end),
         },
         "phase_p50_ms": {
             "queue": _hist_p50(prom1, "mcpx_engine_queue_seconds", prom0),
@@ -610,6 +605,17 @@ async def _run(model_size: str, n_requests: int, concurrency: int, n_services: i
             "decode": _hist_p50(prom1, "mcpx_engine_decode_seconds", prom0),
         },
     }
+
+
+def _fallback_kinds(prom: dict[str, float]) -> dict[str, float]:
+    """Totals per ``kind`` label of mcpx_grammar_fallbacks_total."""
+    out: dict[str, float] = {}
+    for k, v in prom.items():
+        if k.startswith("mcpx_grammar_fallbacks_total"):
+            m = re.search(r'kind="([^"]+)"', k)
+            if m:
+                out[m.group(1)] = out.get(m.group(1), 0.0) + v
+    return out
 
 
 def _pallas_on() -> bool:

@@ -11,9 +11,15 @@ while true; do
   ts=$(date -u +%FT%T)
   if python benchmarks/tunnel_probe.py 75 > /dev/null 2>&1; then
     echo "$ts ALIVE -> launching tpu_session" >> "$LOG"
+    # mtime nonce: keep_if_json deliberately preserves a prior session's
+    # good artifact across a failed session, so "the file says 2b/tpu" is
+    # not evidence THIS session measured anything — require the artifact to
+    # have actually been rewritten since the session started.
+    before=$(stat -c %Y benchmarks/bench_tpu.json 2>/dev/null || echo 0)
     bash benchmarks/tpu_session.sh >> benchmarks/tpu_session_r5.log 2>&1
     echo "$(date -u +%FT%T) session-done" >> "$LOG"
-    if python - <<'EOF'
+    after=$(stat -c %Y benchmarks/bench_tpu.json 2>/dev/null || echo 0)
+    if [ "$after" != "$before" ] && python - <<'EOF'
 import json, sys
 try:
     d = json.load(open("benchmarks/bench_tpu.json"))

@@ -92,9 +92,16 @@ def run_single(spec: str) -> int:
                 "Compose a service DAG.\nIntent: fetch auth\nJSON:"
             )
             t1 = time.monotonic()
+            # First-plan budget: the first constrained generate pays the
+            # registry grammar's device-table upload (~125 MB of BPE trie
+            # tables at 1k services, minutes over the ~1 MB/s tunnel) plus
+            # the grammar-state-bucket executable compiles at the REAL
+            # batch size — measured 124 s at batch 32 (07:44 session). The
+            # old 300 s cap read "slow first plan at batch 64" as "batch 64
+            # failed", demoting sessions to half the proven throughput tier.
             res = await asyncio.wait_for(
                 eng.generate(prompt, constrained=True, grammar=grammar),
-                timeout=300,
+                timeout=float(os.environ.get("MCPX_SMOKE_PLAN_TIMEOUT_S", "720")),
             )
             return {
                 "ok": True,
@@ -123,15 +130,15 @@ def main() -> int:
     if len(sys.argv) == 3 and sys.argv[1] == "--single":
         return run_single(sys.argv[2])
     timeout_s = float(os.environ.get("MCPX_SMOKE_TIMEOUT_S", "900"))
-    # The driver owns the TOTAL budget (default 5100s: THREE full worst-case
-    # attempts at the 1500s child cap — the default ladder is three tiers,
+    # The driver owns the TOTAL budget (default 6300s: THREE full worst-case
+    # attempts at the ~2100s child cap — the default ladder is three tiers,
     # and the 32np Mosaic-attribution tier matters most precisely when the
     # earlier attempts wedge, so the budget must reach it) and sizes each
     # child's cap from what remains — the session script's outer `timeout`
-    # (5400s) must never fire mid-attempt: a SIGTERM to this driver would
+    # (6600s) must never fire mid-attempt: a SIGTERM to this driver would
     # orphan a --single child that still holds the tunnel and HBM, and the
     # next session step would block silently behind it.
-    deadline = time.monotonic() + float(os.environ.get("MCPX_SMOKE_TOTAL_S", "5100"))
+    deadline = time.monotonic() + float(os.environ.get("MCPX_SMOKE_TOTAL_S", "6300"))
     # Ladder: full config, then halve the batch (HBM hypothesis), then the
     # same small batch without the Pallas kernel (Mosaic hypothesis). A
     # 32np success where 32 failed pins the failure on the kernel.
@@ -156,7 +163,8 @@ def main() -> int:
             break
         # start watchdog + generate cap + compile/teardown slack, so the
         # child's own bounded failure paths normally fire first.
-        child_cap = min(timeout_s + 300 + 300, remaining)
+        plan_cap = float(os.environ.get("MCPX_SMOKE_PLAN_TIMEOUT_S", "720"))
+        child_cap = min(timeout_s + plan_cap + 300, remaining)
         print(f"smoke: trying 2b batch={batch}", file=sys.stderr, flush=True)
         try:
             proc = subprocess.run(

@@ -40,12 +40,15 @@ keep_if_json() {  # $1 tmp, $2 dest — only complete JSON may replace a good ar
 # smoke_tpu.json when this one produces nothing, and a stale "ok" must not
 # steer this session's steps.
 # Budget covers THREE worst-case wedged attempts (64, 32, 32np at the
-# 1500s child cap each) + floor slack: the 32np Mosaic-attribution tier
+# ~2100s child cap each) + floor slack: the 32np Mosaic-attribution tier
 # matters most precisely when the earlier attempts wedge, so it must not
 # be the one the budget starves. Outer timeout stays clear of the driver's
 # own deadline so it never SIGTERMs mid-attempt.
-export MCPX_SMOKE_TOTAL_S="${MCPX_SMOKE_TOTAL_S:-5100}"
-timeout 5400 python benchmarks/startup_smoke.py \
+export MCPX_SMOKE_TOTAL_S="${MCPX_SMOKE_TOTAL_S:-6300}"
+# Outer timeout DERIVED from the driver's budget: an operator-raised
+# MCPX_SMOKE_TOTAL_S must not re-create the mid-attempt SIGTERM hazard a
+# hardcoded cap would reintroduce.
+timeout "$((${MCPX_SMOKE_TOTAL_S%.*} + 300))" python benchmarks/startup_smoke.py \
   2> benchmarks/logs/smoke.err | grep -E '^\{' | tail -1 > benchmarks/.smoke_out
 cp benchmarks/.smoke_out benchmarks/.smoke_tpu.tmp
 keep_if_json benchmarks/.smoke_tpu.tmp benchmarks/smoke_tpu.json
