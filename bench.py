@@ -37,6 +37,12 @@ Environment knobs:
     MCPX_BENCH_RATE_FRACTION  phase-2 offered load as a fraction of measured
                               throughput (default 0.7)
     MCPX_BENCH_LATENCY_REQUESTS  phase-2 request count (default 192)
+    MCPX_BENCH_PALLAS    0 = fused-jnp attention even on TPU (smoke ladder)
+    MCPX_BENCH_TICK / _DEPTH / _MINFREE / _WAIT / _SPEC / _DRAFT
+                         worker-loop levers (decode_steps_per_tick,
+                         pipeline_depth, admit_min_free, admit_max_wait_s,
+                         speculate_k, draft_mode) — bake the probe sweep's
+                         p50-optimal point into the headline run
 """
 
 from __future__ import annotations
@@ -193,6 +199,22 @@ def _build_config(model_size: str):
                 # budget + speculation slack in 4 x 64-token pages (SP mode
                 # doubles the page budget — see pages_cfg above).
                 **pages_cfg,
+                # Worker-loop levers, overridable so the probe sweep's
+                # p50-optimal point can be served by the headline bench
+                # without a code change (VERDICT r4 next #2). Defaults =
+                # EngineConfig defaults.
+                **{
+                    cfg_key: conv(os.environ[env])
+                    for env, cfg_key, conv in (
+                        ("MCPX_BENCH_TICK", "decode_steps_per_tick", int),
+                        ("MCPX_BENCH_DEPTH", "pipeline_depth", int),
+                        ("MCPX_BENCH_MINFREE", "admit_min_free", int),
+                        ("MCPX_BENCH_WAIT", "admit_max_wait_s", float),
+                        ("MCPX_BENCH_SPEC", "speculate_k", int),
+                        ("MCPX_BENCH_DRAFT", "draft_mode", str),
+                    )
+                    if env in os.environ
+                },
                 "temperature": 0.0,
                 # Derived from the live backend (like benchmarks/ladder.py):
                 # after the _device_guard CPU fallback, a pinned
@@ -484,6 +506,13 @@ async def _run(model_size: str, n_requests: int, concurrency: int, n_services: i
             )
         )
 
+        # Open-loop phase scrape: the phase split that matters for the p50
+        # target is THIS phase's (queue under Little's law in the closed
+        # loop says nothing about engine latency — the same reason p50_ms
+        # and sat_p50_ms are separate headline fields).
+        async with session.get(f"{base}/metrics") as resp:
+            prom2 = _parse_prom(await resp.text())
+
     # ---- Quality sample: are served plans on-intent? (VERDICT r3 weak #4)
     # A separate small loop AFTER the timed phases so per-response scoring
     # can't contaminate throughput/latency numbers. Random-weight models
@@ -599,10 +628,19 @@ async def _run(model_size: str, n_requests: int, concurrency: int, n_services: i
             **{k: 0 for k in ("shape_only", "keys_free", "typed_off")},
             **_fallback_kinds(prom_end),
         },
+        # Saturation-phase split: queue here is Little's-law backlog at
+        # 256-way concurrency — read it with sat_p50_ms, not p50_ms.
         "phase_p50_ms": {
             "queue": _hist_p50(prom1, "mcpx_engine_queue_seconds", prom0),
             "prefill": _hist_p50(prom1, "mcpx_engine_prefill_seconds", prom0),
             "decode": _hist_p50(prom1, "mcpx_engine_decode_seconds", prom0),
+        },
+        # Open-loop split: the decomposition of p50_ms — the phase the
+        # <150 ms north-star target is scored on.
+        "phase_p50_open_ms": {
+            "queue": _hist_p50(prom2, "mcpx_engine_queue_seconds", prom1),
+            "prefill": _hist_p50(prom2, "mcpx_engine_prefill_seconds", prom1),
+            "decode": _hist_p50(prom2, "mcpx_engine_decode_seconds", prom1),
         },
     }
 
