@@ -184,8 +184,12 @@ def _build_config(model_size: str):
                 # MCPX_BENCH_BATCH: HBM-pressure escape hatch — engine slab
                 # rows scale KV pools + per-bucket executables linearly, so
                 # halving this is the first move when 2b startup hits
-                # RESOURCE_EXHAUSTED on a single chip.
-                "max_batch_size": int(os.environ.get("MCPX_BENCH_BATCH", "64")),
+                # RESOURCE_EXHAUSTED on a single chip. Unset, the default is
+                # the batch the startup smoke PROVED on this hardware
+                # (benchmarks/smoke_tpu.json) — the driver's round-end run
+                # has no session script to export the proven value, and the
+                # one measured batch-64 attempt wedged the first generate.
+                "max_batch_size": _bench_batch(model_size),
                 # Decode budget is an INFORMATION budget: 40 BPE tokens carry
                 # more JSON than the 96 byte-tokens the old config allowed
                 # (measured ~6-8 chars/token on plan text). Oversizing it
@@ -405,151 +409,162 @@ async def _run(model_size: str, n_requests: int, concurrency: int, n_services: i
     app = build_app(cp)
     server = TestServer(app)
     await server.start_server()
-    base = f"http://{server.host}:{server.port}"
+    try:
+        base = f"http://{server.host}:{server.port}"
 
-    rng = random.Random(11)
-    from mcpx.utils.synth import intent_for
+        rng = random.Random(11)
+        from mcpx.utils.synth import intent_for
 
-    records = await cp.registry.list_services()
-    n_lat = int(os.environ.get("MCPX_BENCH_LATENCY_REQUESTS", "192"))
-    # Repeat-intent mode (SURVEY §5 plan-cache lever, VERDICT r4 next #8):
-    # MCPX_BENCH_UNIQUE_INTENTS=N draws the workload from a pool of N
-    # unique intents (expected cache hit share ≈ 1 - N/requests). Default 0
-    # = every request unique, which cache-busts by construction — the
-    # headline number stays an engine measurement, never a cache one.
-    n_unique = int(os.environ.get("MCPX_BENCH_UNIQUE_INTENTS", "0"))
-    n_total = n_requests + n_lat
-    if n_unique > 0:
-        pool = [f"{intent_for(records, rng)} [{i}]" for i in range(n_unique)]
-        intents = [pool[i % n_unique] for i in range(n_total)]
-    else:
-        intents = [f"{intent_for(records, rng)} [{i}]" for i in range(n_total)]
+        records = await cp.registry.list_services()
+        n_lat = int(os.environ.get("MCPX_BENCH_LATENCY_REQUESTS", "192"))
+        # Repeat-intent mode (SURVEY §5 plan-cache lever, VERDICT r4 next #8):
+        # MCPX_BENCH_UNIQUE_INTENTS=N draws the workload from a pool of N
+        # unique intents (expected cache hit share ≈ 1 - N/requests). Default 0
+        # = every request unique, which cache-busts by construction — the
+        # headline number stays an engine measurement, never a cache one.
+        n_unique = int(os.environ.get("MCPX_BENCH_UNIQUE_INTENTS", "0"))
+        n_total = n_requests + n_lat
+        if n_unique > 0:
+            pool = [f"{intent_for(records, rng)} [{i}]" for i in range(n_unique)]
+            intents = [pool[i % n_unique] for i in range(n_total)]
+        else:
+            intents = [f"{intent_for(records, rng)} [{i}]" for i in range(n_total)]
 
-    origins: dict[str, int] = {}
+        origins: dict[str, int] = {}
 
-    t_setup0 = time.monotonic()
-    async with ClientSession(connector=TCPConnector(limit=concurrency)) as session:
-        # Engine bring-up runs as a server background task; wait for
-        # /healthz to report ready before the request warmup (this also
-        # exercises the warming-state health surface).
-        while True:
-            async with session.get(f"{base}/healthz") as resp:
-                health = await resp.json()
-            if health.get("engine") in ("ready", "n/a", None):
-                break
-            if health.get("engine") == "failed":
-                raise RuntimeError(
-                    "engine failed during startup: "
-                    + health.get("engine_error", "(no detail)")
-                )
-            await asyncio.sleep(1.0)
+        t_setup0 = time.monotonic()
+        async with ClientSession(connector=TCPConnector(limit=concurrency)) as session:
+            # Engine bring-up runs as a server background task; wait for
+            # /healthz to report ready before the request warmup (this also
+            # exercises the warming-state health surface).
+            while True:
+                async with session.get(f"{base}/healthz") as resp:
+                    health = await resp.json()
+                if health.get("engine") in ("ready", "n/a", None):
+                    break
+                if health.get("engine") == "failed":
+                    raise RuntimeError(
+                        "engine failed during startup: "
+                        + health.get("engine_error", "(no detail)")
+                    )
+                await asyncio.sleep(1.0)
 
-        async def plan_once(intent: str) -> tuple[int, float]:
+            async def plan_once(intent: str) -> tuple[int, float]:
+                t0 = time.monotonic()
+                async with session.post(f"{base}/plan", json={"intent": intent}) as resp:
+                    body = await resp.json()
+                    if resp.status == 200:
+                        o = body.get("origin", "unknown")
+                        origins[o] = origins.get(o, 0) + 1
+                    return resp.status, (time.monotonic() - t0) * 1e3
+
+            # Warmup: trigger engine startup + compile for the hot batch buckets.
+            warm = [f"warmup intent {i}" for i in range(cfg.engine.max_batch_size)]
+            statuses = await asyncio.gather(*(plan_once(w) for w in warm))
+            bad = [s for s, _ in statuses if s != 200]
+            if bad:
+                raise RuntimeError(f"warmup failed: {len(bad)}/{len(warm)} non-200 responses")
+            warmup_s = time.monotonic() - t_setup0
+            origins.clear()
+
+            async with session.get(f"{base}/metrics") as resp:
+                prom0 = _parse_prom(await resp.text())
+
+            # ---- Phase 1: closed-loop saturation -> plans/sec
+            sat_lat: list[float] = []
+            errors = 0
+            sem = asyncio.Semaphore(concurrency)
+
+            async def one_sat(intent: str) -> None:
+                nonlocal errors
+                async with sem:
+                    status, ms = await plan_once(intent)
+                    if status != 200:
+                        errors += 1
+                    sat_lat.append(ms)
+
             t0 = time.monotonic()
-            async with session.post(f"{base}/plan", json={"intent": intent}) as resp:
-                body = await resp.json()
-                if resp.status == 200:
-                    o = body.get("origin", "unknown")
-                    origins[o] = origins.get(o, 0) + 1
-                return resp.status, (time.monotonic() - t0) * 1e3
+            await asyncio.gather(*(one_sat(i) for i in intents[:n_requests]))
+            elapsed = time.monotonic() - t0
+            plans_per_sec = n_requests / elapsed
 
-        # Warmup: trigger engine startup + compile for the hot batch buckets.
-        warm = [f"warmup intent {i}" for i in range(cfg.engine.max_batch_size)]
-        statuses = await asyncio.gather(*(plan_once(w) for w in warm))
-        bad = [s for s, _ in statuses if s != 200]
-        if bad:
-            raise RuntimeError(f"warmup failed: {len(bad)}/{len(warm)} non-200 responses")
-        warmup_s = time.monotonic() - t_setup0
-        origins.clear()
+            async with session.get(f"{base}/metrics") as resp:
+                prom1 = _parse_prom(await resp.text())
 
-        async with session.get(f"{base}/metrics") as resp:
-            prom0 = _parse_prom(await resp.text())
+            # ---- Phase 2: open-loop latency at a fraction of measured throughput
+            rate_frac = float(os.environ.get("MCPX_BENCH_RATE_FRACTION", "0.7"))
+            rate = max(0.5, plans_per_sec * rate_frac)
+            open_lat: list[float] = []
 
-        # ---- Phase 1: closed-loop saturation -> plans/sec
-        sat_lat: list[float] = []
-        errors = 0
-        sem = asyncio.Semaphore(concurrency)
-
-        async def one_sat(intent: str) -> None:
-            nonlocal errors
-            async with sem:
+            async def one_open(intent: str, delay: float) -> None:
+                nonlocal errors
+                await asyncio.sleep(delay)
                 status, ms = await plan_once(intent)
                 if status != 200:
                     errors += 1
-                sat_lat.append(ms)
+                open_lat.append(ms)
 
-        t0 = time.monotonic()
-        await asyncio.gather(*(one_sat(i) for i in intents[:n_requests]))
-        elapsed = time.monotonic() - t0
-        plans_per_sec = n_requests / elapsed
-
-        async with session.get(f"{base}/metrics") as resp:
-            prom1 = _parse_prom(await resp.text())
-
-        # ---- Phase 2: open-loop latency at a fraction of measured throughput
-        rate_frac = float(os.environ.get("MCPX_BENCH_RATE_FRACTION", "0.7"))
-        rate = max(0.5, plans_per_sec * rate_frac)
-        open_lat: list[float] = []
-
-        async def one_open(intent: str, delay: float) -> None:
-            nonlocal errors
-            await asyncio.sleep(delay)
-            status, ms = await plan_once(intent)
-            if status != 200:
-                errors += 1
-            open_lat.append(ms)
-
-        await asyncio.gather(
-            *(
-                one_open(intent, i / rate)
-                for i, intent in enumerate(intents[n_requests:])
+            await asyncio.gather(
+                *(
+                    one_open(intent, i / rate)
+                    for i, intent in enumerate(intents[n_requests:])
+                )
             )
-        )
 
-        # Open-loop phase scrape: the phase split that matters for the p50
-        # target is THIS phase's (queue under Little's law in the closed
-        # loop says nothing about engine latency — the same reason p50_ms
-        # and sat_p50_ms are separate headline fields).
-        async with session.get(f"{base}/metrics") as resp:
-            prom2 = _parse_prom(await resp.text())
+            # Open-loop phase scrape: the phase split that matters for the p50
+            # target is THIS phase's (queue under Little's law in the closed
+            # loop says nothing about engine latency — the same reason p50_ms
+            # and sat_p50_ms are separate headline fields).
+            async with session.get(f"{base}/metrics") as resp:
+                prom2 = _parse_prom(await resp.text())
 
-    # ---- Quality sample: are served plans on-intent? (VERDICT r3 weak #4)
-    # A separate small loop AFTER the timed phases so per-response scoring
-    # can't contaminate throughput/latency numbers. Random-weight models
-    # score near the registry base rate here; trained checkpoints high.
-    from mcpx.planner.quality import mean_quality, plan_quality
+        # ---- Quality sample: are served plans on-intent? (VERDICT r3 weak #4)
+        # A separate small loop AFTER the timed phases so per-response scoring
+        # can't contaminate throughput/latency numbers. Random-weight models
+        # score near the registry base rate here; trained checkpoints high.
+        from mcpx.planner.quality import mean_quality, plan_quality
 
-    by_name = {r.name: r for r in records}
-    q_rows = []
-    q_origins: dict[str, int] = {}
-    async with ClientSession() as session:
-        for i in range(32):
-            intent = intent_for(records, rng)
-            async with session.post(f"{base}/plan", json={"intent": intent}) as resp:
-                if resp.status != 200:
-                    continue
-                body = await resp.json()
-                o = body.get("origin", "unknown")
-                q_origins[o] = q_origins.get(o, 0) + 1
-                q_rows.append(plan_quality(body.get("graph") or {}, intent, by_name))
-    quality = mean_quality(q_rows)
-    # Heuristic fallbacks would inflate the MODEL's apparent quality — the
-    # share is reported so a degenerate sample is visible, like the timed
-    # phases' llm_share gate.
-    quality["llm_share"] = q_origins.get("llm", 0) / max(1, sum(q_origins.values()))
+        by_name = {r.name: r for r in records}
+        q_rows = []
+        q_origins: dict[str, int] = {}
+        async with ClientSession() as session:
+            for i in range(32):
+                intent = intent_for(records, rng)
+                async with session.post(f"{base}/plan", json={"intent": intent}) as resp:
+                    if resp.status != 200:
+                        continue
+                    body = await resp.json()
+                    o = body.get("origin", "unknown")
+                    q_origins[o] = q_origins.get(o, 0) + 1
+                    q_rows.append(plan_quality(body.get("graph") or {}, intent, by_name))
+        quality = mean_quality(q_rows)
+        # Heuristic fallbacks would inflate the MODEL's apparent quality — the
+        # share is reported so a degenerate sample is visible, like the timed
+        # phases' llm_share gate.
+        quality["llm_share"] = q_origins.get("llm", 0) / max(1, sum(q_origins.values()))
 
-    # End-of-run scrape: grammar_fallback must cover EVERY build this
-    # process ran (warmup before prom0, both timed phases, the quality
-    # sample after prom1) — a build that degraded anywhere in the run means
-    # some reported number was served by a degraded grammar.
-    async with ClientSession() as session:
-        async with session.get(f"{base}/metrics") as resp:
-            prom_end = _parse_prom(await resp.text())
+        # End-of-run scrape: grammar_fallback must cover EVERY build this
+        # process ran (warmup before prom0, both timed phases, the quality
+        # sample after prom1) — a build that degraded anywhere in the run means
+        # some reported number was served by a degraded grammar.
+        async with ClientSession() as session:
+            async with session.get(f"{base}/metrics") as resp:
+                prom_end = _parse_prom(await resp.text())
 
-    await server.close()
-    engine = getattr(cp.planner, "engine", None)
-    if engine is not None and engine.state == "ready":
-        await engine.aclose()
+    finally:
+        # Teardown in a FINALLY: a cancelled run (MCPX_BENCH_RUN_TIMEOUT_S
+        # hang-guard) must not leak the engine HBM + TestServer into the
+        # in-process model=test fallback retry. Each step is itself bounded
+        # and best-effort: teardown of a wedged engine must not become a
+        # second hang.
+        import contextlib
+
+        with contextlib.suppress(Exception):
+            await asyncio.wait_for(server.close(), 30)
+        engine = getattr(cp.planner, "engine", None)
+        if engine is not None and engine.state == "ready":
+            with contextlib.suppress(Exception):
+                await asyncio.wait_for(engine.aclose(), 30)
 
     if errors > max(1, (n_requests + n_lat) // 100):
         raise BenchGateError(f"{errors}/{n_requests + n_lat} requests failed")
@@ -645,6 +660,50 @@ async def _run(model_size: str, n_requests: int, concurrency: int, n_services: i
     }
 
 
+def _smoke_artifact() -> dict:
+    """benchmarks/smoke_tpu.json if present and ok, else {} — the last
+    hardware-PROVEN 2b bring-up config (batch, pallas)."""
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "benchmarks", "smoke_tpu.json"
+    )
+    try:
+        with open(path) as f:
+            d = json.load(f)
+        return d if d.get("ok") else {}
+    except Exception:  # noqa: BLE001 - absent/garbled artifact = no evidence
+        return {}
+
+
+def _bench_batch(model_size: str) -> int:
+    """Engine batch: env override > smoke-proven value (2b only) > 64.
+    The 2b fallback without smoke evidence is 32: the only measured batch-64
+    attempt hung its first generate and took the relay down with it — on the
+    driver's unattended round-end run, a conservative batch that SERVES
+    beats an aggressive one that wedges. Adoption from the artifact is
+    ANNOUNCED on stderr (and the served batch/kernel are fields of the
+    output JSON) because keep_if_json deliberately preserves a previous
+    session's smoke across a failed one — what steered a run must be
+    readable off the run itself, never inferred from defaults."""
+    env = os.environ.get("MCPX_BENCH_BATCH")
+    if env:
+        return int(env)
+    if model_size == "2b":
+        art = _smoke_artifact()
+        proven = art.get("batch")
+        if proven:
+            if not getattr(_bench_batch, "_announced", False):
+                _bench_batch._announced = True
+                print(
+                    f"bench: adopting smoke-proven batch={proven} "
+                    f"(pallas={art.get('pallas', True)}) from "
+                    "benchmarks/smoke_tpu.json",
+                    file=sys.stderr,
+                )
+            return int(proven)
+        return 32
+    return 64
+
+
 def _fallback_kinds(prom: dict[str, float]) -> dict[str, float]:
     """Totals per ``kind`` label of mcpx_grammar_fallbacks_total."""
     out: dict[str, float] = {}
@@ -657,9 +716,15 @@ def _fallback_kinds(prom: dict[str, float]) -> dict[str, float]:
 
 
 def _pallas_on() -> bool:
-    """Pallas only on TPU, and only unless the smoke ladder proved this
-    session must serve the fused-jnp path (MCPX_BENCH_PALLAS=0)."""
-    return _on_tpu() and os.environ.get("MCPX_BENCH_PALLAS", "1") != "0"
+    """Pallas only on TPU; MCPX_BENCH_PALLAS overrides explicitly, else the
+    smoke artifact's proven kernel config applies (a smoke that only served
+    fused-jnp must steer the driver's unattended round-end run too)."""
+    if not _on_tpu():
+        return False
+    env = os.environ.get("MCPX_BENCH_PALLAS")
+    if env is not None:
+        return env != "0"
+    return bool(_smoke_artifact().get("pallas", True))
 
 
 def _on_tpu() -> bool:
@@ -713,15 +778,37 @@ def main() -> None:
     if model is None:
         model = "2b" if _on_tpu() else "test"
 
+    # Bounded: the measured batch-64 failure mode is a generate that never
+    # resolves (worker thread stuck in a device call) — an exception clause
+    # cannot catch a hang, but wait_for regains control because the stuck
+    # call lives in the engine's worker THREAD, not this event loop. The
+    # driver's unattended round-end run must always terminate. ONE deadline
+    # covers both attempts: a fresh budget for the fallback tier would let
+    # worst-case runtime (2x) blow through the session script's step timeout
+    # and lose the artifact anyway.
+    run_deadline = time.monotonic() + float(
+        os.environ.get("MCPX_BENCH_RUN_TIMEOUT_S", "2400")
+    )
+
+    def _run_bounded(m: str):
+        budget = max(120.0, run_deadline - time.monotonic())
+
+        async def go():
+            return await asyncio.wait_for(
+                _run(m, n_requests, concurrency, n_services), budget
+            )
+
+        return asyncio.run(go())
+
     try:
-        stats = asyncio.run(_run(model, n_requests, concurrency, n_services))
+        stats = _run_bounded(model)
     except BenchGateError:
         raise  # honesty gate: a degenerate run must fail, not retry smaller
     except Exception as e:  # noqa: BLE001 - one fallback tier, then report
         print(f"bench: model={model} failed ({type(e).__name__}: {e}); retrying size=test",
               file=sys.stderr)
         model = "test"
-        stats = asyncio.run(_run(model, n_requests, concurrency, n_services))
+        stats = _run_bounded(model)
 
     # Bounded so a second engine bring-up can never hang the process past
     # the session script's step timeout and discard the already-measured
@@ -772,6 +859,9 @@ def main() -> None:
                 "phase_p50_ms": {
                     k: round(v, 1) for k, v in stats["phase_p50_ms"].items()
                 },
+                "phase_p50_open_ms": {
+                    k: round(v, 1) for k, v in stats["phase_p50_open_ms"].items()
+                },
                 # Intent-match quality of the headline run's plans (random
                 # weights score near base rate) and of the committed trained
                 # checkpoint served through the same stack (null when no
@@ -785,6 +875,8 @@ def main() -> None:
                     if isinstance(quality_trained, dict) else None
                 ),
                 "model": model,
+                "batch": _bench_batch(model),
+                "pallas": _pallas_on(),
                 "vocab": os.environ.get("MCPX_BENCH_VOCAB", "bpe"),
                 "registry": os.environ.get("MCPX_BENCH_REGISTRY", "synthetic"),
                 "backend": stats["backend"],

@@ -1,0 +1,96 @@
+"""bench.py config plumbing: the honesty-critical knobs that steer a TPU
+session (smoke ladder -> env -> engine config) and the fallback-kind scrape
+that surfaces grammar degradations in the one JSON line the operator reads.
+
+These are host-side pure functions — no engine, no device."""
+
+import importlib.util
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402  (stdlib-only module level; jax untouched)
+
+
+def _smoke():
+    spec = importlib.util.spec_from_file_location(
+        "startup_smoke", os.path.join(REPO, "benchmarks", "startup_smoke.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_smoke_spec_parse():
+    sm = _smoke()
+    assert sm._parse_spec("64") == (64, True)
+    assert sm._parse_spec("32np") == (32, False)
+    with pytest.raises(ValueError):
+        sm._parse_spec("banana")
+
+
+def test_pallas_gate_forces_fused_jnp(monkeypatch):
+    monkeypatch.setenv("MCPX_BENCH_PALLAS", "0")
+    cfg = bench._build_config("test")
+    assert cfg.engine.use_pallas is False
+
+
+def test_worker_lever_knobs(monkeypatch):
+    monkeypatch.setenv("MCPX_BENCH_TICK", "2")
+    monkeypatch.setenv("MCPX_BENCH_DEPTH", "3")
+    monkeypatch.setenv("MCPX_BENCH_MINFREE", "16")
+    monkeypatch.setenv("MCPX_BENCH_WAIT", "0.05")
+    monkeypatch.setenv("MCPX_BENCH_SPEC", "4")
+    monkeypatch.setenv("MCPX_BENCH_DRAFT", "off")
+    cfg = bench._build_config("test")
+    e = cfg.engine
+    assert (
+        e.decode_steps_per_tick,
+        e.pipeline_depth,
+        e.admit_min_free,
+        e.speculate_k,
+        e.draft_mode,
+    ) == (2, 3, 16, 4, "off")
+    assert abs(e.admit_max_wait_s - 0.05) < 1e-9
+
+
+def test_worker_lever_defaults_untouched(monkeypatch):
+    for env in (
+        "MCPX_BENCH_TICK",
+        "MCPX_BENCH_DEPTH",
+        "MCPX_BENCH_MINFREE",
+        "MCPX_BENCH_WAIT",
+        "MCPX_BENCH_SPEC",
+        "MCPX_BENCH_DRAFT",
+    ):
+        monkeypatch.delenv(env, raising=False)
+    from mcpx.core.config import EngineConfig
+
+    cfg = bench._build_config("test")
+    assert cfg.engine.decode_steps_per_tick == EngineConfig.decode_steps_per_tick
+    assert cfg.engine.pipeline_depth == EngineConfig.pipeline_depth
+
+
+def test_fallback_kinds_scrape_is_kind_complete():
+    """A NEW degradation kind minted in the planner shows up in the bench
+    honesty field without a bench change; canonical kinds are explicit 0s."""
+    prom = {
+        'mcpx_grammar_fallbacks_total{kind="typed_off"}': 3.0,
+        'mcpx_grammar_fallbacks_total{kind="shape_only"}': 1.0,
+        'mcpx_grammar_fallbacks_total{kind="some_future_kind"}': 2.0,
+        "mcpx_plans_total": 9.0,
+    }
+    out = {
+        **{k: 0 for k in ("shape_only", "keys_free", "typed_off")},
+        **bench._fallback_kinds(prom),
+    }
+    assert out == {
+        "shape_only": 1.0,
+        "keys_free": 0,
+        "typed_off": 3.0,
+        "some_future_kind": 2.0,
+    }
