@@ -561,8 +561,13 @@ async def _run(model_size: str, n_requests: int, concurrency: int, n_services: i
 
         with contextlib.suppress(Exception):
             await asyncio.wait_for(server.close(), 30)
+        # aclose() whatever the state: a run-timeout can land mid-BRING-UP
+        # (2b startup alone is ~167 s), and a "warming" engine's worker
+        # thread holds weights+KV in HBM just as much as a ready one's.
+        # aclose is state-agnostic (signals the worker, joins bounded,
+        # drops device buffers); on a cold engine it is a cheap no-op.
         engine = getattr(cp.planner, "engine", None)
-        if engine is not None and engine.state == "ready":
+        if engine is not None and engine.state != "closed":
             with contextlib.suppress(Exception):
                 await asyncio.wait_for(engine.aclose(), 30)
 
@@ -693,10 +698,14 @@ def _bench_batch(model_size: str) -> int:
         if proven:
             if not getattr(_bench_batch, "_announced", False):
                 _bench_batch._announced = True
+                # Announce the EFFECTIVE kernel path (_pallas_on folds in
+                # any MCPX_BENCH_PALLAS override), not the artifact's value
+                # — the one human-readable config line in an unattended
+                # session log must match what was served.
                 print(
-                    f"bench: adopting smoke-proven batch={proven} "
-                    f"(pallas={art.get('pallas', True)}) from "
-                    "benchmarks/smoke_tpu.json",
+                    f"bench: adopting smoke-proven batch={proven} from "
+                    f"benchmarks/smoke_tpu.json (serving pallas="
+                    f"{_pallas_on()})",
                     file=sys.stderr,
                 )
             return int(proven)

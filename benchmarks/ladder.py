@@ -48,6 +48,14 @@ def _on_tpu() -> bool:
     return jax.default_backend() not in ("cpu",)
 
 
+def _announced_pallas() -> bool:
+    p = _pallas_on()
+    if not getattr(_announced_pallas, "_done", False):
+        _announced_pallas._done = True
+        print(f"ladder: serving pallas={p}", file=sys.stderr)
+    return p
+
+
 
 
 def _config(model_size: str, max_batch: int = 32, checkpoint: str = "",
@@ -61,17 +69,24 @@ def _config(model_size: str, max_batch: int = 32, checkpoint: str = "",
                       "checkpoint_path": checkpoint},
             "engine": {
                 "max_batch_size": max_batch,
-                # Information budget on the BPE vocab (see bench.py): 48
-                # subword tokens >= the plan JSON 96 byte-tokens held.
-                "max_decode_len": 48,
+                # SAME geometry as bench.py's BPE config (decode budget 64,
+                # 4 x 64-token pages): every (batch, len) bucket executable
+                # then comes out of the persistent XLA compilation cache the
+                # headline bench already filled — a divergent geometry cost
+                # config 3 of the r5 TPU ladder ~13 min of recompiles over
+                # the tunnel before its outer timeout loomed.
+                "max_decode_len": 64,
                 "kv_page_size": 64,
-                "max_pages_per_seq": 6,
+                "max_pages_per_seq": 4,
                 "temperature": 0.0,
-                # bench._pallas_on: TPU backend AND the session-wide
+                # bench._pallas_on: TPU backend, the session-wide
                 # MCPX_BENCH_PALLAS gate (tpu_session.sh sets =0 when the
-                # smoke only served with the Pallas kernel off) — one
-                # definition of the knob, not a re-parse per script.
-                "use_pallas": _pallas_on(),
+                # smoke only served with the Pallas kernel off), else the
+                # smoke artifact's proven kernel config — one definition of
+                # the knob, not a re-parse per script. The effective value
+                # is announced once at startup (what steered a run must be
+                # readable off the run itself).
+                "use_pallas": _announced_pallas(),
                 "warmup_compile": _on_tpu(),
             },
             "planner": {"kind": "llm", "max_plan_retries": 0,
