@@ -679,38 +679,41 @@ def _smoke_artifact() -> dict:
         return {}
 
 
+def _serving_announced(batch: int, source: str, tag: str = "bench") -> int:
+    """Single owner of the serving-config announcement: one stderr line, in
+    EVERY entrypoint's log and on every resolution path (env, smoke
+    artifact, default), recording the effective batch + kernel path — what
+    steered a run must be readable off the run itself, never inferred from
+    defaults, and _pallas_on() here folds in any MCPX_BENCH_PALLAS override
+    so the line matches what was actually served. Returns ``batch`` so call
+    sites can announce at the point of resolution."""
+    if not getattr(_serving_announced, "_done", False):
+        _serving_announced._done = True
+        print(
+            f"{tag}: serving batch={batch} ({source}) pallas={_pallas_on()}",
+            file=sys.stderr,
+        )
+    return batch
+
+
 def _bench_batch(model_size: str) -> int:
     """Engine batch: env override > smoke-proven value (2b only) > 64.
     The 2b fallback without smoke evidence is 32: the only measured batch-64
     attempt hung its first generate and took the relay down with it — on the
     driver's unattended round-end run, a conservative batch that SERVES
-    beats an aggressive one that wedges. Adoption from the artifact is
-    ANNOUNCED on stderr (and the served batch/kernel are fields of the
-    output JSON) because keep_if_json deliberately preserves a previous
-    session's smoke across a failed one — what steered a run must be
-    readable off the run itself, never inferred from defaults."""
+    beats an aggressive one that wedges. keep_if_json deliberately preserves
+    a previous session's smoke across a failed one, so every path announces
+    via _serving_announced (and the served batch/kernel are fields of the
+    output JSON)."""
     env = os.environ.get("MCPX_BENCH_BATCH")
     if env:
-        return int(env)
+        return _serving_announced(int(env), "env MCPX_BENCH_BATCH")
     if model_size == "2b":
-        art = _smoke_artifact()
-        proven = art.get("batch")
+        proven = _smoke_artifact().get("batch")
         if proven:
-            if not getattr(_bench_batch, "_announced", False):
-                _bench_batch._announced = True
-                # Announce the EFFECTIVE kernel path (_pallas_on folds in
-                # any MCPX_BENCH_PALLAS override), not the artifact's value
-                # — the one human-readable config line in an unattended
-                # session log must match what was served.
-                print(
-                    f"bench: adopting smoke-proven batch={proven} from "
-                    f"benchmarks/smoke_tpu.json (serving pallas="
-                    f"{_pallas_on()})",
-                    file=sys.stderr,
-                )
-            return int(proven)
-        return 32
-    return 64
+            return _serving_announced(int(proven), "benchmarks/smoke_tpu.json")
+        return _serving_announced(32, "2b conservative default")
+    return _serving_announced(64, "default")
 
 
 def _fallback_kinds(prom: dict[str, float]) -> dict[str, float]:

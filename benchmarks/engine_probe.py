@@ -29,7 +29,7 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from bench import _pallas_on
+from bench import _pallas_on, _serving_announced
 
 if int(os.environ.get("PROBE_CPU", "0")) > 0:
     from __graft_entry__ import _force_virtual_cpu
@@ -66,8 +66,14 @@ async def run_one(*, model: str, n_req: int, batch: int, tick: int, spec: int,
             "engine": {
                 "max_batch_size": batch,
                 "max_decode_len": budget,
+                # SAME KV geometry as bench.py's BPE config: the r5 sweep
+                # died when the relay dropped during its first entry's
+                # compile burst — pages=16 made every (batch, len) bucket a
+                # fresh executable instead of a persistent-cache hit from
+                # the headline run. 4 x 64-token pages hold the probe's
+                # 128-token prompt + up to a 96-token budget + spec slack.
                 "kv_page_size": 64,
-                "max_pages_per_seq": 16,
+                "max_pages_per_seq": 4,
                 "temperature": 0.0,
                 # One definition of the session-wide Pallas gate (tpu AND
                 # MCPX_BENCH_PALLAS != "0"); the cpu-backend clear below
@@ -89,6 +95,7 @@ async def run_one(*, model: str, n_req: int, batch: int, tick: int, spec: int,
 
     if jax.default_backend() == "cpu":
         cfg.engine.use_pallas = False
+    _serving_announced(batch, "probe config", tag="probe")
     eng = InferenceEngine(cfg)
     t0 = time.monotonic()
     await eng.start()

@@ -31,7 +31,7 @@ import time
 # Runnable as `python benchmarks/ladder.py` from the repo root.
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from bench import _pallas_on
+from bench import _pallas_on, _serving_announced
 
 if int(os.environ.get("MCPX_LADDER_CPU", "0")) > 0:
     # Arm an N-device virtual CPU platform through the shared recipe — env
@@ -48,12 +48,6 @@ def _on_tpu() -> bool:
     return jax.default_backend() not in ("cpu",)
 
 
-def _announced_pallas() -> bool:
-    p = _pallas_on()
-    if not getattr(_announced_pallas, "_done", False):
-        _announced_pallas._done = True
-        print(f"ladder: serving pallas={p}", file=sys.stderr)
-    return p
 
 
 
@@ -62,6 +56,7 @@ def _config(model_size: str, max_batch: int = 32, checkpoint: str = "",
             shortlist_top_k: int = 8):
     from mcpx.core.config import MCPXConfig
 
+    _serving_announced(max_batch, "ladder _config", tag="ladder")
     return MCPXConfig.from_dict(
         {
             # Same serving vocab as bench.py: in-tree BPE (models/bpe.py).
@@ -83,10 +78,9 @@ def _config(model_size: str, max_batch: int = 32, checkpoint: str = "",
                 # MCPX_BENCH_PALLAS gate (tpu_session.sh sets =0 when the
                 # smoke only served with the Pallas kernel off), else the
                 # smoke artifact's proven kernel config — one definition of
-                # the knob, not a re-parse per script. The effective value
-                # is announced once at startup (what steered a run must be
-                # readable off the run itself).
-                "use_pallas": _announced_pallas(),
+                # the knob, not a re-parse per script; announced via the
+                # shared bench._serving_announced above.
+                "use_pallas": _pallas_on(),
                 "warmup_compile": _on_tpu(),
             },
             "planner": {"kind": "llm", "max_plan_retries": 0,
