@@ -687,8 +687,12 @@ def _serving_announced(batch: int, source: str, tag: str = "bench") -> int:
     defaults, and _pallas_on() here folds in any MCPX_BENCH_PALLAS override
     so the line matches what was actually served. Returns ``batch`` so call
     sites can announce at the point of resolution."""
-    if not getattr(_serving_announced, "_done", False):
-        _serving_announced._done = True
+    key = (tag, batch, source, _pallas_on())
+    if getattr(_serving_announced, "_last", None) != key:
+        _serving_announced._last = key
+        # De-dup on the CONFIG, not once-per-process: a probe sweep serves
+        # several batches in one process, and each change must appear in
+        # the log — only repeats of the same effective config are folded.
         print(
             f"{tag}: serving batch={batch} ({source}) pallas={_pallas_on()}",
             file=sys.stderr,

@@ -116,7 +116,20 @@ def train(
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        batch_sharding = NamedSharding(mesh, P("data"))
+        from mcpx.parallel.mesh import batch_axes
+
+        # Batch over EVERY data-parallel axis the mesh carries: ("data",)
+        # on the serving mesh, ("dcn_data", "data") on a multi-slice hybrid
+        # mesh (parallel/mesh.make_hybrid_mesh) — params stay replicated,
+        # so XLA lowers the gradient reduction hierarchically: per-slice
+        # over ICI, then one cross-slice all-reduce over DCN.
+        axes = batch_axes(mesh)
+        batch_sharding = NamedSharding(mesh, P(axes if axes else None))
+        # Divisibility-aware like the rest of parallel/mesh.py: tiny eval
+        # batches (or a trailing odd batch) replicate instead of erroring.
+        batch_ways = 1
+        for a in axes:
+            batch_ways *= mesh.shape[a]
         rep = NamedSharding(mesh, P())
         params = jax.device_put(params, rep)
         opt_state = jax.device_put(opt_state, rep)
@@ -140,7 +153,11 @@ def train(
         return hit.sum(), m.sum()
 
     def _put(a):
-        return jax.device_put(a, batch_sharding) if batch_sharding is not None else a
+        if batch_sharding is None:
+            return a
+        if a.shape[0] % batch_ways != 0:
+            return jax.device_put(a, rep)
+        return jax.device_put(a, batch_sharding)
 
     B = tcfg.batch_size
     losses: list[float] = []

@@ -1,4 +1,6 @@
 from mcpx.parallel.mesh import (
+    batch_axes,
+    make_hybrid_mesh,
     make_mesh,
     param_pspecs,
     kv_cache_pspecs,
@@ -8,6 +10,8 @@ from mcpx.parallel.mesh import (
 )
 
 __all__ = [
+    "batch_axes",
+    "make_hybrid_mesh",
     "make_mesh",
     "param_pspecs",
     "kv_cache_pspecs",

@@ -33,6 +33,7 @@ from mcpx.models.gemma.config import GemmaConfig
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"  # sequence/context parallelism (ring attention)
+DCN_DATA_AXIS = "dcn_data"  # cross-slice data parallelism (multi-host DCN)
 
 
 def make_mesh(
@@ -55,6 +56,44 @@ def make_mesh(
         return Mesh(grid, (DATA_AXIS, SEQ_AXIS, MODEL_AXIS))
     grid = np.asarray(devices[: data * model]).reshape(data, model)
     return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+
+
+def make_hybrid_mesh(
+    dcn_data: int,
+    data: int = 1,
+    model: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Multi-slice mesh ``(dcn_data, data, model)`` — the standard hybrid
+    recipe (docs/DISTRIBUTION.md): pure data parallelism across slices over
+    DCN, TP (and ICI data parallelism) within each slice. The OUTER axis
+    must correspond to slice boundaries, which holds when ``devices`` is
+    process-ordered — ``jax.devices()`` already is, and a real multi-host
+    deployment can pass ``mesh_utils.create_hybrid_device_mesh``'s device
+    array flattened. Gradient all-reduces across ``dcn_data`` are the only
+    cross-slice collectives XLA inserts for this layout: per-slice grads
+    reduce over ICI first (``data``/``model``), then one DCN all-reduce —
+    exactly the hierarchy the hardware wants, and GSPMD derives it from the
+    sharding annotations alone (no hand-written transport; the reference's
+    analogue would be NCCL/MPI process groups)."""
+    devices = list(devices if devices is not None else jax.devices())
+    need = dcn_data * data * model
+    if need > len(devices):
+        raise ConfigError(
+            f"hybrid mesh {dcn_data}x{data}x{model} needs {need} devices, "
+            f"have {len(devices)}"
+        )
+    grid = np.asarray(devices[:need]).reshape(dcn_data, data, model)
+    return Mesh(grid, (DCN_DATA_AXIS, DATA_AXIS, MODEL_AXIS))
+
+
+def batch_axes(mesh: Mesh) -> tuple[str, ...]:
+    """Every data-parallel axis present in ``mesh`` (outer-first), for
+    sharding a batch dimension: ``("dcn_data", "data")`` on a hybrid mesh,
+    ``("data",)`` on the serving mesh."""
+    return tuple(
+        a for a in (DCN_DATA_AXIS, DATA_AXIS) if mesh.shape.get(a, 1) > 1
+    )
 
 
 def _axis(mesh: Mesh, axis: str, dim: int) -> Optional[str]:
