@@ -680,9 +680,10 @@ def _smoke_artifact() -> dict:
 
 
 def _serving_announced(batch: int, source: str, tag: str = "bench") -> int:
-    """Single owner of the serving-config announcement: one stderr line, in
-    EVERY entrypoint's log and on every resolution path (env, smoke
-    artifact, default), recording the effective batch + kernel path — what
+    """Single owner of the serving-config announcement: one stderr line per
+    effective-config CHANGE (repeats fold; a sweep's per-entry overrides
+    each appear), in EVERY entrypoint's log and on every resolution path
+    (env, smoke artifact, default), recording the batch + kernel path — what
     steered a run must be readable off the run itself, never inferred from
     defaults, and _pallas_on() here folds in any MCPX_BENCH_PALLAS override
     so the line matches what was actually served. Returns ``batch`` so call

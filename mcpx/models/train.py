@@ -123,16 +123,26 @@ def train(
         # mesh (parallel/mesh.make_hybrid_mesh) — params stay replicated,
         # so XLA lowers the gradient reduction hierarchically: per-slice
         # over ICI, then one cross-slice all-reduce over DCN.
-        axes = batch_axes(mesh)
-        batch_sharding = NamedSharding(mesh, P(axes if axes else None))
-        # Divisibility-aware like the rest of parallel/mesh.py: tiny eval
-        # batches (or a trailing odd batch) replicate instead of erroring.
-        batch_ways = 1
-        for a in axes:
-            batch_ways *= mesh.shape[a]
+        all_axes = batch_axes(mesh)
         rep = NamedSharding(mesh, P())
         params = jax.device_put(params, rep)
         opt_state = jax.device_put(opt_state, rep)
+
+        def _batch_sharding(n_rows: int) -> NamedSharding:
+            # Per-axis divisibility like parallel/mesh._axis: drop only the
+            # axes that don't divide this batch (outer-first keeps the
+            # cross-slice split when it fits), so a trailing/eval batch
+            # keeps whatever data parallelism still divides instead of
+            # replicating wholesale.
+            axes: list[str] = []
+            ways = 1
+            for a in all_axes:
+                if n_rows % (ways * mesh.shape[a]) == 0:
+                    axes.append(a)
+                    ways *= mesh.shape[a]
+            return NamedSharding(mesh, P(tuple(axes) if axes else None))
+
+        batch_sharding = _batch_sharding
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def train_step(params, opt_state, tokens, seq_lens, loss_mask):
@@ -155,9 +165,7 @@ def train(
     def _put(a):
         if batch_sharding is None:
             return a
-        if a.shape[0] % batch_ways != 0:
-            return jax.device_put(a, rep)
-        return jax.device_put(a, batch_sharding)
+        return jax.device_put(a, batch_sharding(a.shape[0]))
 
     B = tcfg.batch_size
     losses: list[float] = []
