@@ -179,7 +179,15 @@ def _build_config(model_size: str):
             # synthetic registry distribution (bpe.py docstring); real
             # registries with different naming compress materially worse —
             # real-checkpoint serving uses the SentencePiece vocab instead.
-            "model": {"size": model_size, "max_seq_len": 2048, "vocab": vocab},
+            "model": {
+                "size": model_size,
+                "max_seq_len": 2048,
+                "vocab": vocab,
+                # MCPX_BENCH_QUANTIZE=int8: weight-only int8 serving
+                # (models/gemma/quant.py) — halves HBM bytes-at-rest and
+                # the decode weight-streaming bill.
+                "quantize": os.environ.get("MCPX_BENCH_QUANTIZE", "none"),
+            },
             "engine": {
                 # MCPX_BENCH_BATCH: HBM-pressure escape hatch — engine slab
                 # rows scale KV pools + per-bucket executables linearly, so
@@ -895,6 +903,7 @@ def main() -> None:
                 "batch": _bench_batch(model),
                 "pallas": _pallas_on(),
                 "vocab": os.environ.get("MCPX_BENCH_VOCAB", "bpe"),
+                "quantize": os.environ.get("MCPX_BENCH_QUANTIZE", "none"),
                 "registry": os.environ.get("MCPX_BENCH_REGISTRY", "synthetic"),
                 "backend": stats["backend"],
                 "n_services": n_services,

@@ -124,6 +124,15 @@ MCPX_BENCH_VOCAB=sp MCPX_BENCH_REQUESTS=256 MCPX_BENCH_LATENCY_REQUESTS=96 MCPX_
 keep_if_json benchmarks/.bench_sp.tmp benchmarks/bench_tpu_sp.json
 cat benchmarks/bench_tpu_sp.json 2>/dev/null
 
+# Weight-only int8 row (models/gemma/quant.py): halves the decode
+# weight-streaming bill — on a weight-load-bound decode this is the
+# direct lever — and halves params-at-rest (2B: ~5 GB -> ~2.6 GB),
+# which may be exactly the headroom the batch-64 wedge was missing.
+MCPX_BENCH_QUANTIZE=int8 MCPX_BENCH_REQUESTS=256 MCPX_BENCH_LATENCY_REQUESTS=96 MCPX_BENCH_SKIP_QUALITY=1 \
+  timeout 1800 python bench.py 2> benchmarks/logs/bench_int8.err | grep -E '^\{' | tail -1 > benchmarks/.bench_int8.tmp
+keep_if_json benchmarks/.bench_int8.tmp benchmarks/bench_tpu_int8.json
+cat benchmarks/bench_tpu_int8.json 2>/dev/null
+
 # Latency-profile row (VERDICT r4 next #2): admission tuned for p50 —
 # small cohort hysteresis off (minfree=1), short admit wait, tick 2 so
 # retirement/admission cadence tightens — at a gentler offered load

@@ -49,6 +49,10 @@ class ModelConfig:
     dtype: str = "bfloat16"
     vocab: str = "byte"  # in-tree byte-level tokenizer (no external files)
     max_seq_len: int = 2048
+    # Weight-only serving quantization (models/gemma/quant.py):
+    # "none" | "int8". int8 halves HBM bytes-at-rest and the decode
+    # weight-streaming bill; puts the 7B geometry on a single 16 GB v5e.
+    quantize: str = "none"
 
 
 @dataclass
@@ -325,6 +329,10 @@ class MCPXConfig:
             problems.append("registry.backend=file requires registry.file_path")
         if self.registry.backend == "redis" and not self.registry.redis_url:
             problems.append("registry.backend=redis requires registry.redis_url")
+        if self.model.quantize not in ("none", "int8"):
+            problems.append(
+                f"model.quantize '{self.model.quantize}' not in none|int8"
+            )
         if self.planner.kind not in ("llm", "heuristic", "mock"):
             problems.append(f"planner.kind '{self.planner.kind}' not in llm|heuristic|mock")
         if self.planner.constrain_names not in ("registry", "shortlist", "off"):

@@ -481,8 +481,15 @@ class InferenceEngine:
         if self._mesh is None:
             data_axis, model_axis = self._mesh_axes(len(jax.devices()))
             self._mesh = make_mesh(data=data_axis, model=model_axis)
+        # quantize="int8" (models/gemma/quant.py): the random-init path
+        # quantizes each leaf at creation so the full-precision tree never
+        # exists (7B-int8 on one 16 GB chip); checkpoints quantize after
+        # restore — see load_or_init's documented limitation.
         self._params, source = load_or_init(
-            self.model_cfg, self.config.model.checkpoint_path, self._mesh
+            self.model_cfg,
+            self.config.model.checkpoint_path,
+            self._mesh,
+            quantize=self.config.model.quantize,
         )
         self._paged_kv = self._init_pools()
         # Long-prompt routing (ring prefill): the serving mesh's data

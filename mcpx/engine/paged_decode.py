@@ -58,7 +58,14 @@ def decode_chunk_paged(
     """
     B, S = tokens.shape
     K, L, N, psz, hd = paged_kv["k"].shape
-    x = params["embed"][tokens].astype(jnp.dtype(cfg.dtype))  # [B, S, D]
+    from mcpx.models.gemma.quant import dequant_layer, embed_lookup, unembed
+
+    # Weight-only int8 serving mode (models/gemma/quant.py): identity
+    # plumbing on plain params; the second of the two param choke points.
+    # Quantized leaves stay the HBM-resident buffers — embed rows gather
+    # as int8 + per-row scales, layers dequantize per layer INSIDE the
+    # scan body (see dequant_layer), unembeds scale on the output.
+    x = embed_lookup(params["embed"], tokens, jnp.dtype(cfg.dtype))  # [B, S, D]
     x = x * jnp.asarray(math.sqrt(cfg.d_model), x.dtype)
 
     pos_mat = positions[:, None] + jnp.arange(S, dtype=positions.dtype)  # [B, S]
@@ -85,6 +92,7 @@ def decode_chunk_paged(
 
     def body(carry, lp):
         x, k_all, v_all, layer = carry  # pools: [K, L, N, Psz, hd]
+        lp = dequant_layer(lp, jnp.dtype(cfg.dtype))
         h = rms_norm(x, lp["pre_attn_norm"], cfg.norm_eps)
         q = jnp.einsum("bsd,dkh->bskh", h, lp["wq"])  # [B, S, H, hd]
         k = jnp.einsum("bsd,dkh->bskh", h, lp["wk"])  # [B, S, K, hd]
@@ -127,11 +135,10 @@ def decode_chunk_paged(
         # logits, which is what makes per-position verification affordable
         # at all (the "last-only unembed" optimisation stays intact for the
         # non-draft path below).
-        w = params["embed"][active_cols]  # [C, D]
-        logits_c = jnp.einsum(
-            "bsd,cd->bsc", x, w, preferred_element_type=jnp.float32
-        )
-        return logits_c, {"k": k_new, "v": v_new}
+        return unembed(x, params["embed"], subset=active_cols), {
+            "k": k_new,
+            "v": v_new,
+        }
     if logits_at is not None:
         # Serving only reads ONE position's logits per row (the last valid
         # chunk slot): gather the hidden state BEFORE the unembed so the
@@ -139,12 +146,8 @@ def decode_chunk_paged(
         # 1/S of the all-positions version — at subword vocab sizes that
         # buffer and those FLOPs rival a whole transformer layer.
         x1 = x[jnp.arange(B), logits_at]  # [B, D]
-        logits1 = jnp.einsum(
-            "bd,vd->bv", x1, params["embed"], preferred_element_type=jnp.float32
-        )
-        return logits1, {"k": k_new, "v": v_new}
-    logits = jnp.einsum("bsd,vd->bsv", x, params["embed"], preferred_element_type=jnp.float32)
-    return logits, {"k": k_new, "v": v_new}
+        return unembed(x1, params["embed"]), {"k": k_new, "v": v_new}
+    return unembed(x, params["embed"]), {"k": k_new, "v": v_new}
 
 
 def decode_step_paged(

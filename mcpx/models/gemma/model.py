@@ -35,9 +35,16 @@ KVCache = dict[str, jax.Array]
 
 
 # --------------------------------------------------------------------- init
-def init_params(cfg: GemmaConfig, key: jax.Array) -> Params:
-    """Random-init parameters (bfloat16 by default), layer-stacked."""
+def init_params(cfg: GemmaConfig, key: jax.Array, leaf_transform=None) -> Params:
+    """Random-init parameters (bfloat16 by default), layer-stacked.
+
+    ``leaf_transform(name, array)`` is applied to each tensor AT CREATION
+    (e.g. ``quant.leaf_quantizer`` for int8 serving): intermediates are
+    freed as each transformed leaf replaces them, so the full-precision
+    tree never needs to exist at once — the property that lets 7B-int8
+    initialise on a 16 GB chip."""
     dtype = jnp.dtype(cfg.dtype)
+    t = leaf_transform or (lambda _name, w: w)
     k_embed, k_q, k_k, k_v, k_o, k_gate, k_up, k_down = jax.random.split(key, 8)
     L, D, H, K, hd, F, V = (
         cfg.n_layers,
@@ -49,23 +56,26 @@ def init_params(cfg: GemmaConfig, key: jax.Array) -> Params:
         cfg.vocab_size,
     )
 
-    def normal(key, shape, fan_in):
-        return (jax.random.normal(key, shape, jnp.float32) / math.sqrt(fan_in)).astype(dtype)
+    def normal(name, key, shape, fan_in):
+        return t(
+            name,
+            (jax.random.normal(key, shape, jnp.float32) / math.sqrt(fan_in)).astype(dtype),
+        )
 
     return {
-        "embed": normal(k_embed, (V, D), D),
+        "embed": normal("embed", k_embed, (V, D), D),
         "layers": {
-            "pre_attn_norm": jnp.zeros((L, D), dtype),
-            "pre_mlp_norm": jnp.zeros((L, D), dtype),
-            "wq": normal(k_q, (L, D, H, hd), D),
-            "wk": normal(k_k, (L, D, K, hd), D),
-            "wv": normal(k_v, (L, D, K, hd), D),
-            "wo": normal(k_o, (L, H, hd, D), H * hd),
-            "w_gate": normal(k_gate, (L, D, F), D),
-            "w_up": normal(k_up, (L, D, F), D),
-            "w_down": normal(k_down, (L, F, D), F),
+            "pre_attn_norm": t("pre_attn_norm", jnp.zeros((L, D), dtype)),
+            "pre_mlp_norm": t("pre_mlp_norm", jnp.zeros((L, D), dtype)),
+            "wq": normal("wq", k_q, (L, D, H, hd), D),
+            "wk": normal("wk", k_k, (L, D, K, hd), D),
+            "wv": normal("wv", k_v, (L, D, K, hd), D),
+            "wo": normal("wo", k_o, (L, H, hd, D), H * hd),
+            "w_gate": normal("w_gate", k_gate, (L, D, F), D),
+            "w_up": normal("w_up", k_up, (L, D, F), D),
+            "w_down": normal("w_down", k_down, (L, F, D), F),
         },
-        "final_norm": jnp.zeros((D,), dtype),
+        "final_norm": t("final_norm", jnp.zeros((D,), dtype)),
     }
 
 
@@ -173,12 +183,21 @@ def forward(
     ``mask`` is [B, T, S] (True = attend). ``attend_fn`` swaps the attention
     op (e.g. ring attention for sequence-parallel long-context prefill).
     ``logits_at`` [B]: unembed only that position per row -> [B, V]."""
-    x = params["embed"][tokens].astype(jnp.dtype(cfg.dtype))
+    from mcpx.models.gemma.quant import dequant_layer, embed_lookup, unembed
+
+    # Weight-only int8 serving mode (quant.py): identity plumbing on plain
+    # params. The quantized leaves stay the HBM-resident buffers — embed
+    # rows gather as int8 + per-row scales, and the layer stack dequantizes
+    # PER LAYER inside the scan body (see dequant_layer's docstring for why
+    # position matters).
+    dtype = jnp.dtype(cfg.dtype)
+    x = embed_lookup(params["embed"], tokens, dtype)
     x = x * jnp.asarray(math.sqrt(cfg.d_model), x.dtype)
 
     def body(carry, scanned):
         x = carry
         lp, k_c, v_c = scanned
+        lp = dequant_layer(lp, dtype)
         x, k_c, v_c = _layer(x, lp, k_c, v_c, positions, mask, positions, cfg, attend_fn)
         return x, (k_c, v_c)
 
@@ -194,14 +213,8 @@ def forward(
         # whole layer stack.
         B = tokens.shape[0]
         x1 = x[jnp.arange(B), logits_at]  # [B, D]
-        logits1 = jnp.einsum(
-            "bd,vd->bv", x1, params["embed"], preferred_element_type=jnp.float32
-        )
-        return logits1, {"k": k_new, "v": v_new}
-    logits = jnp.einsum(
-        "btd,vd->btv", x, params["embed"], preferred_element_type=jnp.float32
-    )
-    return logits, {"k": k_new, "v": v_new}
+        return unembed(x1, params["embed"]), {"k": k_new, "v": v_new}
+    return unembed(x, params["embed"]), {"k": k_new, "v": v_new}
 
 
 # -------------------------------------------------------------- entrypoints

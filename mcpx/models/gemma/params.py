@@ -93,14 +93,38 @@ def load_or_init(
     checkpoint_path: str = "",
     mesh: Optional[Mesh] = None,
     seed: int = 0,
+    quantize: str = "none",
 ) -> tuple[Params, str]:
     """Load a checkpoint if configured, else random-init (optionally onto the
-    mesh). Returns (params, source) where source is "checkpoint" | "random"."""
+    mesh). Returns (params, source) where source is "checkpoint" | "random".
+
+    ``quantize="int8"`` (models/gemma/quant.py): the random path quantizes
+    each leaf AT CREATION (full-precision tree never exists at once — the
+    property that lets the 7B geometry initialise int8 on one 16 GB chip).
+    The checkpoint path quantizes after restore, which transiently needs
+    the full-precision footprint on the restoring topology; a single chip
+    that can't hold it needs either a sharded restore across a mesh or an
+    offline pre-quantized checkpoint (documented limitation)."""
     if checkpoint_path:
-        return load_checkpoint(checkpoint_path, cfg, mesh), "checkpoint"
-    params = init_params(cfg, jax.random.PRNGKey(seed))
+        params = load_checkpoint(checkpoint_path, cfg, mesh)
+        if quantize == "int8":
+            from mcpx.models.gemma.quant import quantize_params
+
+            params = quantize_params(params)
+        return params, "checkpoint"
+    leaf_transform = None
+    if quantize == "int8":
+        from mcpx.models.gemma.quant import leaf_quantizer
+
+        leaf_transform = leaf_quantizer
+    params = init_params(cfg, jax.random.PRNGKey(seed), leaf_transform=leaf_transform)
     if mesh is not None:
         from mcpx.parallel.mesh import shard_pytree
 
-        params = shard_pytree(params, param_pspecs(cfg, mesh), mesh)
+        if quantize == "int8":
+            from mcpx.models.gemma.quant import quant_pspecs
+
+            params = shard_pytree(params, quant_pspecs(cfg, mesh), mesh)
+        else:
+            params = shard_pytree(params, param_pspecs(cfg, mesh), mesh)
     return params, "random"

@@ -1,0 +1,149 @@
+"""Weight-only int8 serving quantization (models/gemma/quant.py):
+numerics against the bf16 baseline, HBM-at-rest halving, the 7B-on-one-
+v5e capacity claim, and the full serving stack running quantized."""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mcpx.models.gemma import GemmaConfig, init_kv_cache, init_params, prefill
+from mcpx.models.gemma.quant import (
+    dequant_params,
+    is_quantized,
+    leaf_quantizer,
+    quantize_params,
+    quantized_param_bytes,
+)
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return GemmaConfig(dtype="float32", max_seq_len=64)
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    return init_params(cfg, jax.random.PRNGKey(0))
+
+
+def test_roundtrip_error_bounded(cfg, params):
+    q = quantize_params(params)
+    assert is_quantized(q) and not is_quantized(params)
+    deq = dequant_params(q, jnp.float32)
+    for name in ("wq", "w_down", "w_gate"):
+        a = np.asarray(params["layers"][name], np.float32)
+        b = np.asarray(deq["layers"][name], np.float32)
+        denom = np.abs(a).max()
+        assert np.abs(a - b).max() / denom < 0.01, name  # <1% of absmax
+
+
+def test_streaming_init_matches_posthoc_quantize(cfg, params):
+    """init_params(leaf_transform=leaf_quantizer) — the path that never
+    materialises the full-precision tree — produces the same quantized
+    tree as quantize_params(init_params) for the same key."""
+    stream = jax.jit(
+        lambda: init_params(cfg, jax.random.PRNGKey(0), leaf_transform=leaf_quantizer)
+    )()
+    posthoc = quantize_params(params)
+    for a, b in zip(jax.tree.leaves(stream), jax.tree.leaves(posthoc)):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        af, bf = np.asarray(a, np.float32), np.asarray(b, np.float32)
+        if a.dtype == jnp.int8:
+            # jit-fused vs eager float math: codes may flip by one count on
+            # exact rounding boundaries (observed 1/65536 positions).
+            assert np.abs(af - bf).max() <= 1.0
+            assert (af != bf).mean() < 1e-3
+        else:
+            np.testing.assert_allclose(af, bf, rtol=1e-5, atol=1e-8)
+
+
+def test_prefill_logits_close_to_bf16_baseline(cfg, params):
+    B, T, S = 2, 12, 16
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (B, T), 0, 255)
+    seq_lens = jnp.full((B,), T)
+    ref, _ = jax.jit(prefill, static_argnums=1)(
+        params, cfg, tokens, seq_lens, init_kv_cache(cfg, B, S)
+    )
+    qp = quantize_params(params)
+    got, _ = jax.jit(prefill, static_argnums=1)(
+        qp, cfg, tokens, seq_lens, init_kv_cache(cfg, B, S)
+    )
+    ref, got = np.asarray(ref), np.asarray(got)
+    # int8 weights: logits agree to a few percent of the logit scale, and
+    # greedy next-token choices rarely differ on random weights.
+    scale = np.abs(ref).max()
+    assert np.abs(ref - got).max() / scale < 0.05
+    agree = (ref.argmax(-1) == got.argmax(-1)).mean()
+    assert agree > 0.9, agree
+
+
+def test_bytes_at_rest_halved(cfg):
+    bf16 = sum(
+        int(np.prod(leaf.shape)) * 2
+        for leaf in jax.tree.leaves(
+            jax.eval_shape(lambda: init_params(cfg, jax.random.PRNGKey(0)))
+        )
+    )
+    q = quantized_param_bytes(cfg)
+    assert q < 0.62 * bf16, (q, bf16)  # int8 + f32 scales + norms
+
+
+def test_7b_int8_fits_one_v5e_chip():
+    """The capacity claim behind model.quantize='int8': Gemma-7B geometry
+    at 256k vocab in int8 leaves headroom on a 16 GB chip where bf16
+    (~17.7 GB) cannot even load."""
+    cfg = GemmaConfig.named("7b", vocab_size=256128)
+    bf16 = 2 * cfg.n_params
+    q = quantized_param_bytes(cfg)
+    assert bf16 > 16e9  # bf16 genuinely does not fit
+    assert q < 10e9, q  # int8 + scales leave >=6 GB for KV/activations
+
+
+def test_engine_serves_constrained_plan_quantized():
+    """The full serving stack (admission, paged decode, grammar) runs with
+    int8 weights: same code path, quantized tree at the choke points."""
+    from mcpx.core.config import MCPXConfig
+    from mcpx.engine.engine import InferenceEngine
+    from mcpx.planner.grammar import build_plan_grammar
+
+    async def go():
+        cfg = MCPXConfig.from_dict(
+            {
+                "model": {"size": "test", "vocab": "bpe", "quantize": "int8"},
+                "engine": {
+                    "use_pallas": False,
+                    "max_batch_size": 2,
+                    "max_decode_len": 48,
+                    "max_pages_per_seq": 8,
+                    "temperature": 0.0,
+                },
+            }
+        )
+        cfg.validate()
+        eng = InferenceEngine(cfg)
+        try:
+            await eng.start()
+            assert is_quantized(eng._params)
+            g = build_plan_grammar(eng.tokenizer, ["fetch", "rank"])
+            res = await eng.generate(
+                eng.tokenizer.encode("Intent: fetch then rank\nJSON:"),
+                constrained=True,
+                grammar=g,
+            )
+            state = g.walk(res.text)
+            assert g.is_accept(state), res.text
+        finally:
+            await eng.aclose()
+
+    asyncio.run(go())
+
+
+def test_validate_rejects_unknown_quantize():
+    from mcpx.core.config import MCPXConfig
+    from mcpx.core.errors import ConfigError
+
+    with pytest.raises(ConfigError, match="quantize"):
+        MCPXConfig.from_dict({"model": {"quantize": "int4"}})
