@@ -23,6 +23,7 @@ async def evaluate_planner(
     shortlist_top_k: int = 6,
     use_pallas: Optional[bool] = None,
     constrain_names: str = "registry",
+    quantize: str = "none",
 ) -> dict:
     """Serve ``checkpoint`` through the real control plane (engine +
     retrieval shortlist + grammar-constrained decode) against a synthetic
@@ -51,6 +52,10 @@ async def evaluate_planner(
                 "vocab": vocab,
                 "max_seq_len": 2048,
                 "checkpoint_path": checkpoint,
+                # "int8": serve the checkpoint weight-only quantized
+                # (models/gemma/quant.py) — the eval that shows whether
+                # plan quality survives int8 serving.
+                "quantize": quantize,
             },
             "engine": {
                 # The training corpus geometry (models/corpus.py): 128-token
