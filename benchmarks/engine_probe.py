@@ -37,7 +37,6 @@ if int(os.environ.get("PROBE_CPU", "0")) > 0:
     _force_virtual_cpu(int(os.environ["PROBE_CPU"]))
 
 
-
 _COUNTERS = (
     ("fwd", "decode_forwards"),
     ("tok", "decode_tokens"),

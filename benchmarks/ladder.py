@@ -48,10 +48,6 @@ def _on_tpu() -> bool:
     return jax.default_backend() not in ("cpu",)
 
 
-
-
-
-
 def _config(model_size: str, max_batch: int = 32, checkpoint: str = "",
             shortlist_top_k: int = 8):
     from mcpx.core.config import MCPXConfig

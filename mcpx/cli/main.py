@@ -156,6 +156,7 @@ def cmd_eval_planner(args: argparse.Namespace) -> int:
             n_intents=args.intents,
             seed=args.seed,
             constrain_names=args.constrain_names,
+            quantize=args.quantize,
         )
     )
     print(json.dumps({k: round(v, 4) if isinstance(v, float) else v for k, v in out.items()}))
@@ -214,6 +215,10 @@ def main(argv: list[str] | None = None) -> int:
     p_eval.add_argument("--registry-seed", type=int, default=0)
     p_eval.add_argument("--intents", type=int, default=48)
     p_eval.add_argument("--seed", type=int, default=1234)
+    p_eval.add_argument("--quantize", choices=["none", "int8"], default="none",
+                        help="serve the checkpoint weight-only quantized "
+                        "(models/gemma/quant.py) — reproduces the README's "
+                        "int8 plan-quality claim")
     p_eval.add_argument("--constrain-names", choices=["registry", "shortlist"],
                         default="registry",
                         help="grammar tier: registry-wide name trie (serving "

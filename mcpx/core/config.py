@@ -259,8 +259,51 @@ class PlannerConfig:
 
 
 @dataclass
+class SchedulerConfig:
+    """SLO-aware admission control & scheduling for /plan (mcpx/scheduler/).
+
+    Off by default: with ``enabled=false`` the server's /plan path is
+    byte-identical to the pre-scheduler pass-through (no extra headers, no
+    ``planner`` response field, no scheduling state touched)."""
+
+    enabled: bool = False
+    # The per-request /plan latency objective the ladder defends (the
+    # BASELINE target is p50 < 150 ms at 100 plans/s).
+    slo_ms: float = 150.0
+    # Deadline assumed for requests that send no deadline header; <= 0
+    # means "no deadline" (such requests are never deadline-shed).
+    default_deadline_ms: float = 2000.0
+    # Concurrent /plan executions dispatched past the fair queue. Sized to
+    # the engine's continuous-batching appetite, not aiohttp's (that is
+    # server.max_concurrency, which still applies upstream).
+    max_parallel: int = 64
+    # Queue cap: beyond this, new arrivals shed immediately (429).
+    max_queue_depth: int = 512
+    # Token-bucket rate limit in requests/s over all tenants; 0 disables.
+    rate_limit: float = 0.0
+    burst: int = 32
+    # Headers carrying per-request scheduling identity. Tenant defaults to
+    # "default" when absent — single-tenant deployments need no headers.
+    tenant_header: str = "X-MCPX-Tenant"
+    deadline_header: str = "X-MCPX-Deadline-Ms"
+    priority_header: str = "X-MCPX-Priority"
+    # EWMA smoothing for queue-wait / service-time estimators.
+    ewma_alpha: float = 0.2
+    # Degradation ladder hysteresis: engage the shortlist planner when the
+    # queue-wait EWMA exceeds slo_ms * degrade_threshold; restore LLM
+    # serving when it falls below slo_ms * recover_threshold AND the
+    # ladder has held at least degrade_min_hold_s.
+    degrade_threshold: float = 0.5
+    recover_threshold: float = 0.25
+    degrade_min_hold_s: float = 2.0
+    # Floor for the 429 Retry-After estimate.
+    shed_retry_after_s: float = 1.0
+
+
+@dataclass
 class MCPXConfig:
     server: ServerConfig = field(default_factory=ServerConfig)
+    scheduler: SchedulerConfig = field(default_factory=SchedulerConfig)
     registry: RegistryConfig = field(default_factory=RegistryConfig)
     model: ModelConfig = field(default_factory=ModelConfig)
     engine: EngineConfig = field(default_factory=EngineConfig)
@@ -362,6 +405,24 @@ class MCPXConfig:
         if self.engine.draft_mode not in ("prompt", "off"):
             problems.append(
                 f"engine.draft_mode '{self.engine.draft_mode}' not in prompt|off"
+            )
+        s = self.scheduler
+        if s.slo_ms <= 0:
+            problems.append("scheduler.slo_ms must be > 0")
+        if s.max_parallel < 1:
+            problems.append("scheduler.max_parallel must be >= 1")
+        if s.max_queue_depth < 1:
+            problems.append("scheduler.max_queue_depth must be >= 1")
+        if s.rate_limit < 0:
+            problems.append("scheduler.rate_limit must be >= 0 (0 = unlimited)")
+        if s.rate_limit > 0 and s.burst < 1:
+            problems.append("scheduler.burst must be >= 1 when rate_limit is set")
+        if not 0.0 < s.ewma_alpha <= 1.0:
+            problems.append("scheduler.ewma_alpha must be in (0, 1]")
+        if not 0.0 < s.recover_threshold < s.degrade_threshold:
+            problems.append(
+                "scheduler thresholds must satisfy 0 < recover_threshold "
+                f"({s.recover_threshold}) < degrade_threshold ({s.degrade_threshold})"
             )
         if self.retrieval.shortlist_mode not in ("residual", "topk"):
             problems.append(

@@ -277,6 +277,12 @@ class InferenceEngine:
         self._seg_counter = 0
         self._seq_counter = 0
         self._last_admit_t = 0.0
+        # EWMA of per-request engine service time (prefill + decode wall
+        # seconds, queue wait excluded), updated at retirement. Written by
+        # the worker thread, read cross-thread by queue_stats() — a single
+        # float store is GIL-atomic, and the scheduler's ETA math only
+        # needs an estimate, not a snapshot.
+        self._ewma_service_s = 0.0
         # Per-process entropy so temperature>0 sampling differs across
         # restarts and DP replicas (a bare counter would replay the same
         # stream everywhere); each dispatch folds the counter in.
@@ -412,6 +418,35 @@ class InferenceEngine:
         )
         self._queue.put(req)
         return await req.future
+
+    def queue_stats(self) -> dict:
+        """Cross-thread snapshot of engine load for the serving scheduler
+        (mcpx/scheduler/): how many requests wait unadmitted, how many slab
+        rows are live, and an ETA (seconds) for a request joining the queue
+        NOW. The ETA is fair-share arithmetic over the service-time EWMA —
+        queued requests drain ``max_batch_size`` at a time, plus one extra
+        service interval when the slab is already full (the joiner waits
+        for a drain before its cohort can even admit). All reads are
+        GIL-atomic scalars; approximate by design (the worker thread owns
+        the truth)."""
+        slab = getattr(self, "_slab", None)
+        active = slab.n_active if slab is not None else 0
+        depth = self._queue.qsize()
+        B = max(1, self.config.engine.max_batch_size)
+        svc = self._ewma_service_s
+        # Queued requests that fit the slab's free rows admit at the next
+        # segment boundary (ms) — only the OVERFLOW waits out service
+        # drains, batch-at-a-time.
+        overflow = max(0, depth - max(0, B - active))
+        eta = math.ceil(overflow / B) * svc
+        if active >= B:
+            eta += svc
+        return {
+            "depth": depth,
+            "active": active,
+            "service_ewma_s": svc,
+            "eta_s": eta,
+        }
 
     # ------------------------------------------------------------ internals
     def _mesh_axes(self, n_devices: int) -> tuple[int, int]:
@@ -2076,6 +2111,17 @@ class InferenceEngine:
                     queue_ms=slab.queue_ms[i],
                     prefill_ms=max(0.0, slab.prefill_ms[i]),
                     decode_ms=(t1 - slab.t_decode0[i]) * 1e3,
+                )
+                # Smoothing follows the scheduler's configured alpha: this
+                # EWMA exists to feed queue_stats()'s ETA, which floors the
+                # scheduler's deadline-shed estimate — two reaction speeds
+                # for one gate would make the knob a lie.
+                from mcpx.scheduler.admission import ewma_update
+
+                self._ewma_service_s = ewma_update(
+                    self._ewma_service_s,
+                    (res.prefill_ms + res.decode_ms) / 1e3,
+                    self.config.scheduler.ewma_alpha,
                 )
                 self.metrics.decode_tokens.inc(len(ids))
                 self.metrics.engine_queue_seconds.observe(res.queue_ms / 1e3)

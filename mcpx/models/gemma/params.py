@@ -26,8 +26,14 @@ def _check_shapes(params: Params, cfg: GemmaConfig, path: str) -> None:
     mismatch (e.g. a checkpoint trained on a different vocab) would either
     crash deep inside jit or, worse, broadcast."""
     expected = jax.eval_shape(lambda: init_params(cfg, jax.random.PRNGKey(0)))
-    flat_e = jax.tree.leaves_with_path(expected)
-    flat_p = {jax.tree_util.keystr(k): v for k, v in jax.tree.leaves_with_path(params)}
+    # tree_util spelling: jax.tree.leaves_with_path only exists on jax
+    # >= 0.4.40ish, and this must load checkpoints on the oldest jax the
+    # image family ships.
+    flat_e = jax.tree_util.tree_leaves_with_path(expected)
+    flat_p = {
+        jax.tree_util.keystr(k): v
+        for k, v in jax.tree_util.tree_leaves_with_path(params)
+    }
     problems = []
     expected_keys = set()
     for key, exp in flat_e:
@@ -111,6 +117,15 @@ def load_or_init(
             from mcpx.models.gemma.quant import quantize_params
 
             params = quantize_params(params)
+            if mesh is not None:
+                # Pin the quantized tree (int8 weights + scale leaves) to
+                # quant_pspecs like the random-init branch does — leaving
+                # the scale shardings to XLA inference lets them diverge
+                # from the layout the serving jits were specced against.
+                from mcpx.models.gemma.quant import quant_pspecs
+                from mcpx.parallel.mesh import shard_pytree
+
+                params = shard_pytree(params, quant_pspecs(cfg, mesh), mesh)
         return params, "checkpoint"
     leaf_transform = None
     if quantize == "int8":

@@ -124,4 +124,7 @@ async def evaluate_planner(
     out["llm_share"] = origins.get("llm", 0) / max(1, sum(origins.values()))
     out["node_f1"] = sum(f1s) / len(f1s) if f1s else 0.0
     out["node_f1_n"] = len(f1s)
+    # How the weights were actually served — callers (bench.py, the CLI)
+    # echo this instead of re-deriving it from their own knobs.
+    out["quantize"] = quantize
     return out

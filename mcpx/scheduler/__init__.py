@@ -1,0 +1,29 @@
+"""SLO-aware admission control & scheduling for the /plan serving path.
+
+The pipeline a request crosses before any LLM cost is paid
+(docs/scheduler.md):
+
+  admission (token bucket, queue-depth/ETA deadline shedding)
+    -> fairness (weighted per-tenant fair queuing, EDF within a tenant)
+      -> degradation ladder (sustained overload routes /plan to the
+         shortlist/heuristic planner; hysteresis restores LLM serving)
+
+Disabled by default (``scheduler.enabled=false``): the server's /plan path
+is then byte-identical to the pass-through behavior that existed before
+this subsystem.
+"""
+
+from mcpx.scheduler.admission import RequestContext, ShedError, TokenBucket
+from mcpx.scheduler.degrade import DegradeController
+from mcpx.scheduler.fairness import FairQueue
+from mcpx.scheduler.scheduler import Scheduler, Slot
+
+__all__ = [
+    "DegradeController",
+    "FairQueue",
+    "RequestContext",
+    "Scheduler",
+    "ShedError",
+    "Slot",
+    "TokenBucket",
+]

@@ -31,6 +31,7 @@ from aiohttp import web
 from mcpx.core.dag import Plan, PlanValidationError
 from mcpx.core.errors import PlannerError, RegistryError
 from mcpx.registry.base import ServiceRecord
+from mcpx.scheduler import ShedError
 from mcpx.server.control import ControlPlane
 
 log = logging.getLogger("mcpx.server")
@@ -128,20 +129,48 @@ def build_app(cp: ControlPlane) -> web.Application:
         intent = body.get("intent")
         if not isinstance(intent, str) or not intent.strip():
             return _json_error(400, "'intent' must be a non-empty string")
+        # SLO-aware admission scheduler (mcpx/scheduler/): read per-request
+        # so it can be attached/detached on a live server (bench overload
+        # phase). None = the pre-scheduler pass-through path, byte-identical
+        # responses included (no "planner" field).
+        sched = cp.scheduler
+        slot = None
+        if sched is not None:
+            ctx = sched.context_from_headers(request.headers)
+            try:
+                slot = await sched.acquire(ctx)
+            except ShedError as e:
+                return web.json_response(
+                    {
+                        "error": f"admission refused: {e}",
+                        "retry_after_s": e.retry_after_s,
+                    },
+                    status=429,
+                    headers={"Retry-After": e.retry_after_header()},
+                )
         try:
-            p, latency_ms = await cp.plan(intent)
+            p, latency_ms = await cp.plan(
+                intent, degraded=slot.degraded if slot is not None else False
+            )
         except PlannerError as e:
             return _json_error(422, f"planning failed: {e}")
-        return web.json_response(
-            {
-                "graph": p.to_wire(),
-                "explanation": p.explanation,
-                # Which planner authored the plan ("llm" | "heuristic" | ...):
-                # lets clients/benchmarks attribute accept rate per request.
-                "origin": p.origin,
-                "latency_ms": round(latency_ms, 3),
-            }
-        )
+        finally:
+            if slot is not None:
+                sched.release(slot)
+        resp = {
+            "graph": p.to_wire(),
+            "explanation": p.explanation,
+            # Which planner authored the plan ("llm" | "heuristic" | ...):
+            # lets clients/benchmarks attribute accept rate per request.
+            "origin": p.origin,
+            "latency_ms": round(latency_ms, 3),
+        }
+        if slot is not None:
+            # Which serving tier the degradation ladder picked: "primary" =
+            # the configured planner, "degraded" = routed to the shortlist
+            # planner under sustained overload.
+            resp["planner"] = "degraded" if slot.degraded else "primary"
+        return web.json_response(resp)
 
     # --------------------------------------------------------------- execute
     async def execute(request: web.Request) -> web.Response:

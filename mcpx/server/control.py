@@ -19,6 +19,7 @@ from mcpx.core.dag import Plan
 from mcpx.core.trace import ExecutionTrace
 from mcpx.orchestrator.executor import ExecuteResult, Orchestrator
 from mcpx.planner.base import PlanContext, Planner
+from mcpx.planner.heuristic import HeuristicPlanner
 from mcpx.registry.base import RegistryBackend
 from mcpx.telemetry.metrics import Metrics
 from mcpx.telemetry.replan import ReplanPolicy
@@ -39,6 +40,7 @@ class ControlPlane:
         replan_policy: Optional[ReplanPolicy] = None,
         telemetry_mirror: Any = None,  # mcpx.telemetry.mirror.RedisTelemetryMirror
         redis_plan_cache: Any = None,  # mcpx.server.plan_cache.RedisPlanCache
+        scheduler: Any = None,  # mcpx.scheduler.Scheduler (None = pass-through)
     ) -> None:
         self.config = config or MCPXConfig()
         self.registry = registry
@@ -50,6 +52,14 @@ class ControlPlane:
         self.replan_policy = replan_policy or ReplanPolicy(self.config.telemetry)
         self.telemetry_mirror = telemetry_mirror
         self.redis_plan_cache = redis_plan_cache
+        # SLO-aware admission scheduler (mcpx/scheduler/). Read per-request
+        # by the /plan handler, so it can be attached/detached at runtime
+        # (bench.py's overload phase enables it against a live server).
+        self.scheduler = scheduler
+        # Degradation target: the model-free shortlist planner — it still
+        # plans over the retrieval shortlist via _context, so degraded
+        # service is the "shortlist planner" tier, not a blind fallback.
+        self.degraded_planner = HeuristicPlanner(self.config.planner)
         self._plan_cache: OrderedDict[tuple[str, int], Plan] = OrderedDict()
         self._cache_writes: set = set()  # in-flight shared-tier writes
 
@@ -74,8 +84,17 @@ class ControlPlane:
                 )
 
     # ------------------------------------------------------------------ plan
-    async def plan(self, intent: str, *, use_cache: bool = True) -> tuple[Plan, float]:
-        """Plan an intent; returns (plan, latency_ms)."""
+    async def plan(
+        self, intent: str, *, use_cache: bool = True, degraded: bool = False
+    ) -> tuple[Plan, float]:
+        """Plan an intent; returns (plan, latency_ms).
+
+        ``degraded=True`` (scheduler degradation ladder) serves the
+        shortlist/heuristic planner instead of the configured one. Cache
+        READS stay on — a hit returns a previously LLM-authored plan at
+        heuristic cost, the best possible degraded response — but degraded
+        plans are never WRITTEN to any cache tier (they would keep serving
+        heuristic plans after the ladder recovers)."""
         t0 = time.monotonic()
         version = await self.registry.version()
         key = (intent, version)
@@ -99,22 +118,23 @@ class ControlPlane:
         if use_cache and (local_tier or self.redis_plan_cache is not None):
             self.metrics.plan_cache.labels(result="miss").inc()
 
+        planner = self.degraded_planner if degraded else self.planner
         context = await self._context(intent, version=version)
         try:
-            plan = await self.planner.plan(intent, context)
+            plan = await planner.plan(intent, context)
             self.metrics.plans.labels(
-                planner=type(self.planner).__name__,
+                planner=type(planner).__name__,
                 origin=plan.origin or "unknown",
                 status="ok",
             ).inc()
         except Exception:
             self.metrics.plans.labels(
-                planner=type(self.planner).__name__, origin="none", status="error"
+                planner=type(planner).__name__, origin="none", status="error"
             ).inc()
             raise
-        if use_cache and self.config.planner.plan_cache_size > 0:
+        if use_cache and not degraded and self.config.planner.plan_cache_size > 0:
             self._cache_put(key, plan)
-        if use_cache and self.redis_plan_cache is not None:
+        if use_cache and not degraded and self.redis_plan_cache is not None:
             self._redis_cache_write(intent, version, plan)
         return plan, (time.monotonic() - t0) * 1e3
 

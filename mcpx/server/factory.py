@@ -89,6 +89,20 @@ def build_control_plane(
 
                 raise ConfigError(f"planner.kind=llm unavailable: {e}") from e
             planner = LLMPlanner.from_config(config, retriever=retriever, metrics=metrics)
+    scheduler = None
+    if config.scheduler.enabled:
+        from mcpx.scheduler import Scheduler
+
+        # The engine's queue ETA (depth x service-time EWMA) floors the
+        # scheduler's own estimate; heuristic/mock planners have no engine
+        # and the scheduler then estimates from its own grant/release
+        # accounting alone.
+        engine = getattr(planner, "engine", None)
+        scheduler = Scheduler(
+            config.scheduler,
+            metrics,
+            engine_stats=engine.queue_stats if engine is not None else None,
+        )
     return ControlPlane(
         config=config,
         registry=registry,
@@ -100,4 +114,5 @@ def build_control_plane(
         replan_policy=ReplanPolicy(config.telemetry),
         telemetry_mirror=telemetry_mirror,
         redis_plan_cache=redis_plan_cache,
+        scheduler=scheduler,
     )

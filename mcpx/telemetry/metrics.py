@@ -140,6 +140,34 @@ class Metrics:
             "gives goodput model-FLOPs for MFU accounting",
             registry=self.registry,
         )
+        # Scheduler (mcpx/scheduler/): admission decisions, queue wait, and
+        # ladder state. outcome: admitted | degraded (admitted but routed to
+        # the shortlist planner by the degradation ladder) | shed_rate |
+        # shed_queue | shed_deadline — mutually exclusive, so shares are
+        # ratios over the summed counter.
+        self.sched_decisions = Counter(
+            "mcpx_sched_decisions_total",
+            "Scheduler admission decisions (admitted/degraded/shed_*)",
+            ["outcome"],
+            registry=self.registry,
+        )
+        self.sched_queue_wait = Histogram(
+            "mcpx_sched_queue_wait_seconds",
+            "Scheduler queue wait (enqueue to dispatch) for admitted requests",
+            buckets=LATENCY_BUCKETS,
+            registry=self.registry,
+        )
+        self.sched_queue_depth = Gauge(
+            "mcpx_sched_queue_depth",
+            "Requests waiting in the scheduler's fair queue",
+            registry=self.registry,
+        )
+        self.sched_degraded = Gauge(
+            "mcpx_sched_degraded_mode",
+            "1 while the degradation ladder is routing /plan to the "
+            "shortlist planner instead of the LLM",
+            registry=self.registry,
+        )
         # Per-request engine phase latencies, observed at retirement: where a
         # request's wall time went (admission queue wait vs prefill vs decode)
         # — the split VERDICT r2 demanded in the bench artifacts.

@@ -44,10 +44,29 @@ def force_virtual_cpu(n_devices: int = 1) -> None:
         from jax.extend import backend as jeb
 
         jeb.clear_backends()
-    jax.config.update("jax_num_cpu_devices", n_devices)
+    try:
+        jax.config.update("jax_num_cpu_devices", n_devices)
+    except AttributeError:
+        # jax < 0.5 has no jax_num_cpu_devices config. The XLA_FLAGS set
+        # above covers every not-yet-initialized process (the conftest /
+        # CLI cases); an already-latched backend that cannot be re-armed on
+        # this version trips the device-count check below instead of
+        # silently serving the wrong platform.
+        pass
     jax.config.update("jax_platforms", "cpu")
     got = len(jax.devices("cpu"))
     if got != n_devices:
         raise RuntimeError(
             f"virtual CPU platform has {got} devices, wanted {n_devices}"
         )
+
+
+def mesh_context(mesh):
+    """Ambient-mesh context manager across jax versions: ``jax.set_mesh``
+    where it exists (jax >= 0.5), else the ``Mesh`` object itself (the
+    context-manager spelling older jax uses). Shared by tests and the
+    multichip dryrun so version drift stays in one place."""
+    import jax
+
+    set_mesh = getattr(jax, "set_mesh", None)
+    return set_mesh(mesh) if set_mesh is not None else mesh

@@ -1,0 +1,21 @@
+"""Repo-local source hygiene checks (ADVICE r5): no runs of >= 3
+consecutive blank lines may land in mcpx/ or benchmarks/ — the residue
+editing sessions leave behind when deleting blocks."""
+
+import pathlib
+import re
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+_BLANK_RUN = re.compile(r"(?:^[ \t]*\n){3,}", re.MULTILINE)
+
+
+def test_no_blank_line_runs():
+    bad: list[str] = []
+    for root in ("mcpx", "benchmarks"):
+        for path in sorted((REPO / root).rglob("*.py")):
+            text = path.read_text()
+            for m in _BLANK_RUN.finditer(text):
+                line = text[: m.start()].count("\n") + 1
+                bad.append(f"{path.relative_to(REPO)}:{line}")
+    assert not bad, f"runs of >=3 consecutive blank lines: {bad}"
