@@ -1,0 +1,26 @@
+"""mcpxlint: AST-based static analysis for async-safety and JAX/TPU
+hot-path hygiene. See docs/static-analysis.md.
+
+Entry points: ``mcpx lint`` (CLI, mcpx/cli/main.py), the tier-1 gate
+(tests/test_mcpxlint.py), and this package's :func:`scan_paths` API.
+"""
+
+from mcpx.analysis.baseline import (
+    DEFAULT_BASELINE,
+    apply_baseline,
+    load_baseline,
+    save_baseline,
+)
+from mcpx.analysis.core import Finding, Rule, ScanResult, all_rules, scan_paths
+
+__all__ = [
+    "DEFAULT_BASELINE",
+    "Finding",
+    "Rule",
+    "ScanResult",
+    "all_rules",
+    "apply_baseline",
+    "load_baseline",
+    "save_baseline",
+    "scan_paths",
+]

@@ -1,0 +1,129 @@
+"""`mcpx lint` driver: scan, diff against the committed baseline, report.
+
+Exit codes: 0 = clean (every finding suppressed or baselined, no stale
+baseline entries); 1 = new findings and/or stale entries. ``--format json``
+emits one machine-readable object (findings + run telemetry) for CI and
+dashboards; text mode prints one ``path:line rule-id message`` per finding.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+from typing import Iterable, Optional
+
+from mcpx.analysis.baseline import (
+    DEFAULT_BASELINE,
+    apply_baseline,
+    load_baseline,
+    save_baseline,
+)
+from mcpx.analysis.core import scan_paths
+
+
+def run_lint(
+    paths: Iterable[str],
+    *,
+    baseline: str = DEFAULT_BASELINE,
+    update_baseline: bool = False,
+    fmt: str = "text",
+    rules: Optional[Iterable[str]] = None,
+    root: Optional[str] = None,
+    out=None,
+) -> int:
+    out = out if out is not None else sys.stdout
+    root_path = pathlib.Path(root) if root else pathlib.Path.cwd()
+    if rules is not None:
+        rules = list(rules)
+    try:
+        result = scan_paths(
+            [pathlib.Path(p) for p in paths], root=root_path, rules=rules
+        )
+    except ValueError as e:  # unknown --rule id: a usage error, not a crash
+        print(f"mcpxlint: error: {e}", file=out)
+        return 2
+    baseline_path = pathlib.Path(baseline)
+    if not baseline_path.is_absolute():
+        baseline_path = root_path / baseline_path
+    def _load_entries():
+        # Malformed/truncated baseline JSON is a usage error, not a crash:
+        # same exit-2 contract as an unknown --rule id.
+        try:
+            return load_baseline(baseline_path)
+        except (ValueError, OSError) as e:
+            print(f"mcpxlint: error: cannot read baseline: {e}", file=out)
+            return None
+
+    if update_baseline:
+        keep: list = []
+        if rules is not None:
+            # A --rule pass only re-baselines the rules that ran; other
+            # rules' grandfathered entries pass through untouched instead of
+            # being silently wiped.
+            selected = set(rules)
+            entries = _load_entries()
+            if entries is None:
+                return 2
+            keep = [e for e in entries if e["rule"] not in selected]
+        n = len(result.findings) + len(keep)
+        save_baseline(baseline_path, result.findings, keep=keep)
+        print(
+            f"mcpxlint: wrote {n} entr{'y' if n == 1 else 'ies'} to {baseline_path}",
+            file=out,
+        )
+        return 0
+    baseline_missing = not baseline_path.exists()
+    entries = _load_entries()
+    if entries is None:
+        return 2
+    if rules is not None:
+        # Same guard the suppression engine applies: baseline entries are
+        # judged only against rules that actually ran, or a --rule pass
+        # would report every other rule's grandfathered entry as stale.
+        selected = set(rules)
+        entries = [e for e in entries if e["rule"] in selected]
+    new, baselined, stale = apply_baseline(result.findings, entries)
+
+    if fmt == "json":
+        payload = {
+            **result.summary(),
+            "new": [f.to_dict() for f in new],
+            "baselined": baselined,
+            "stale_baseline": stale,
+            "baseline_missing": baseline_missing,
+            "exit": 1 if (new or stale) else 0,
+        }
+        print(json.dumps(payload, indent=2), file=out)
+    else:
+        for f in new:
+            print(f.render(), file=out)
+        for e in stale:
+            print(
+                f"{e['path']}:{e['line']} stale-baseline baseline entry for "
+                f"'{e['rule']}' matches no current finding — delete it "
+                f"from {baseline_path.name}",
+                file=out,
+            )
+        if baseline_missing:
+            # Loud, not fatal: a fresh project legitimately has no baseline,
+            # but a wrong cwd or mistyped --baseline silently dropping every
+            # grandfathered entry must be visible in the report.
+            print(
+                f"mcpxlint: note: baseline {baseline_path} not found; "
+                "treating as empty (run from the repo root, or pass "
+                "--baseline)",
+                file=out,
+            )
+        counts = ", ".join(
+            f"{k}={v}" for k, v in sorted(result.counts_by_rule.items())
+        )
+        print(
+            f"mcpxlint: {len(new)} new finding(s), {baselined} baselined, "
+            f"{result.suppressed} suppressed, {len(stale)} stale baseline "
+            f"entr{'y' if len(stale) == 1 else 'ies'} across "
+            f"{result.files_scanned} files in {result.duration_s:.2f}s"
+            + (f" [{counts}]" if counts else ""),
+            file=out,
+        )
+    return 1 if (new or stale) else 0

@@ -1,0 +1,226 @@
+"""mcpxlint core: findings, the rule registry, per-line suppressions and
+the scan engine.
+
+mcpxlint is an AST-based analyzer for the two regimes where this codebase's
+silent bugs live: the asyncio control plane (blocking calls in coroutines,
+unlocked shared-state writes across awaits) and the jitted TPU engine
+(host-device syncs and Python control flow inside traced scopes). Rules
+register themselves via :func:`rule`; the engine parses each file once,
+hands every rule a :class:`FileContext`, applies ``# mcpx: ignore[rule-id]``
+suppressions, and reports anything left.
+
+Suppression grammar (same line as the finding, trailing comment; the
+placeholder below is deliberately not a real rule id — suppressions are
+matched textually, docstrings included)::
+
+    risky_call()  # mcpx: ignore[rule-id] - one-line justification
+
+Unused suppressions are themselves findings (``unused-suppression``) so the
+tree can't accumulate dead annotations.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import pathlib
+import re
+import time
+from typing import Callable, Iterable, Optional
+
+_SUPPRESS_RE = re.compile(r"#\s*mcpx:\s*ignore\[([a-z0-9_\-, ]+)\]")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One analyzer hit. ``path`` is root-relative posix."""
+
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line} {self.rule} {self.message}"
+
+    @property
+    def key(self) -> tuple[str, str, int]:
+        return (self.path, self.rule, self.line)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class FileContext:
+    """Everything a rule may look at for one file: raw text, split lines and
+    a lazily-parsed AST (one parse shared by every AST rule)."""
+
+    def __init__(self, path: pathlib.Path, relpath: str, text: str) -> None:
+        self.path = path
+        self.relpath = relpath
+        self.text = text
+        self.lines = text.splitlines()
+        self._tree: Optional[ast.Module] = None
+        self.parse_error: Optional[str] = None
+        self._parsed = False
+        # Cross-rule memo (e.g. jit-scope discovery, shared by both jax
+        # rules) — same lifetime as the parsed tree.
+        self.cache: dict = {}
+
+    @property
+    def tree(self) -> Optional[ast.Module]:
+        if not self._parsed:
+            self._parsed = True
+            try:
+                self._tree = ast.parse(self.text)
+            except SyntaxError as e:
+                self.parse_error = f"{e.msg} (line {e.lineno})"
+        return self._tree
+
+    def finding(self, line: int, rule_id: str, message: str) -> Finding:
+        return Finding(path=self.relpath, line=line, rule=rule_id, message=message)
+
+    def suppressions(self) -> dict[int, set[str]]:
+        """line -> rule ids suppressed on that line."""
+        out: dict[int, set[str]] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                out[i] = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        return out
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str
+    summary: str
+    check: Callable[[FileContext], Iterable[Finding]]
+    needs_ast: bool = True
+
+
+_REGISTRY: dict[str, Rule] = {}
+
+
+def rule(rule_id: str, summary: str, *, needs_ast: bool = True):
+    """Register an analyzer rule. The decorated callable receives a
+    :class:`FileContext` and yields :class:`Finding`s."""
+
+    def deco(fn: Callable[[FileContext], Iterable[Finding]]):
+        if rule_id in _REGISTRY:
+            raise ValueError(f"duplicate rule id {rule_id!r}")
+        _REGISTRY[rule_id] = Rule(rule_id, summary, fn, needs_ast=needs_ast)
+        return fn
+
+    return deco
+
+
+# Engine-internal rule ids (not callables, but documented and reportable).
+PARSE_ERROR = "parse-error"
+UNUSED_SUPPRESSION = "unused-suppression"
+
+
+def all_rules() -> dict[str, Rule]:
+    _load_builtin_rules()
+    return dict(_REGISTRY)
+
+
+def _load_builtin_rules() -> None:
+    # Deferred so `import mcpx.analysis.core` never cycles with rule modules.
+    from mcpx.analysis import rules  # noqa: F401
+
+
+@dataclasses.dataclass
+class ScanResult:
+    findings: list[Finding]          # after suppression, before baseline
+    suppressed: int
+    files_scanned: int
+    duration_s: float
+    counts_by_rule: dict[str, int] = dataclasses.field(default_factory=dict)
+
+    def summary(self) -> dict:
+        """Machine-readable run telemetry (mirrored into --format json)."""
+        return {
+            "files_scanned": self.files_scanned,
+            "findings": len(self.findings),
+            "suppressed": self.suppressed,
+            "duration_s": round(self.duration_s, 3),
+            "counts_by_rule": dict(sorted(self.counts_by_rule.items())),
+        }
+
+
+def iter_py_files(paths: Iterable[pathlib.Path]) -> list[pathlib.Path]:
+    out: set[pathlib.Path] = set()
+    for p in paths:
+        p = pathlib.Path(p)
+        if p.is_dir():
+            out.update(p.rglob("*.py"))
+        elif p.suffix == ".py":
+            out.add(p)
+    return sorted(out)
+
+
+def scan_paths(
+    paths: Iterable[pathlib.Path],
+    *,
+    root: Optional[pathlib.Path] = None,
+    rules: Optional[Iterable[str]] = None,
+) -> ScanResult:
+    """Run the selected rules (default: all registered) over every ``*.py``
+    under ``paths``. Findings carry ``root``-relative paths."""
+    registry = all_rules()
+    if rules is not None:
+        rules = list(rules)  # may be a one-shot iterator; it's read twice
+        unknown = sorted(set(rules) - set(registry))
+        if unknown:
+            raise ValueError(f"unknown rule id(s): {', '.join(unknown)}")
+        registry = {k: registry[k] for k in rules}
+    root = pathlib.Path(root) if root is not None else pathlib.Path.cwd()
+    t0 = time.monotonic()
+    active: list[Finding] = []
+    suppressed = 0
+    counts: dict[str, int] = {}
+    files = iter_py_files(paths)
+    for path in files:
+        try:
+            rel = path.resolve().relative_to(root.resolve()).as_posix()
+        except ValueError:
+            rel = path.as_posix()
+        ctx = FileContext(path, rel, path.read_text())
+        raw: list[Finding] = []
+        for r in registry.values():
+            if r.needs_ast and ctx.tree is None:
+                continue
+            raw.extend(r.check(ctx))
+        if ctx.parse_error is not None and any(r.needs_ast for r in registry.values()):
+            raw.append(ctx.finding(1, PARSE_ERROR, f"cannot parse: {ctx.parse_error}"))
+        sup = ctx.suppressions()
+        used: set[tuple[int, str]] = set()
+        for f in sorted(set(raw), key=lambda f: (f.line, f.rule, f.message)):
+            ids = sup.get(f.line, ())
+            if f.rule in ids:
+                suppressed += 1
+                used.add((f.line, f.rule))
+            else:
+                active.append(f)
+                counts[f.rule] = counts.get(f.rule, 0) + 1
+        for line, ids in sorted(sup.items()):
+            for rid in sorted(ids):
+                # A suppression is judged only against rules that actually
+                # ran: a blank-lines-only pass must not report every
+                # broad-except annotation in the tree as unused.
+                if rid in registry and (line, rid) not in used:
+                    f = ctx.finding(
+                        line,
+                        UNUSED_SUPPRESSION,
+                        f"suppression for '{rid}' matches no finding on this line",
+                    )
+                    active.append(f)
+                    counts[UNUSED_SUPPRESSION] = counts.get(UNUSED_SUPPRESSION, 0) + 1
+    active.sort(key=lambda f: (f.path, f.line, f.rule))
+    return ScanResult(
+        findings=active,
+        suppressed=suppressed,
+        files_scanned=len(files),
+        duration_s=time.monotonic() - t0,
+        counts_by_rule=counts,
+    )

@@ -1,0 +1,5 @@
+"""Built-in mcpxlint rules. Importing this package registers every rule
+with the core registry; add a module here (and import it below) to ship a
+new rule — see docs/static-analysis.md."""
+
+from mcpx.analysis.rules import async_rules, jax_rules, style_rules  # noqa: F401

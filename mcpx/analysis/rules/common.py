@@ -1,0 +1,149 @@
+"""Shared AST plumbing for mcpxlint rules: dotted-name resolution, scope
+walks that respect function boundaries, and jit-scope discovery."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional, Union
+
+FunctionNode = Union[ast.FunctionDef, ast.AsyncFunctionDef]
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+# Spellings under which jax.jit / pjit appear in this codebase.
+JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit", "jax.experimental.pjit.pjit"}
+# lax control-flow combinators -> positional args that are traced callables.
+_TRACED_CALLEE_ARGS = {
+    "lax.scan": (0,),
+    "jax.lax.scan": (0,),
+    "lax.while_loop": (0, 1),
+    "jax.lax.while_loop": (0, 1),
+    "lax.cond": (1, 2),
+    "jax.lax.cond": (1, 2),
+    "lax.fori_loop": (2,),
+    "jax.lax.fori_loop": (2,),
+    "lax.map": (0,),
+    "jax.lax.map": (0,),
+}
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains (``self.x`` -> "self.x"); None
+    for anything rooted elsewhere (calls, subscripts, literals)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    return dotted_name(call.func)
+
+
+def walk_scope(fn: FunctionNode, *, include_nested_defs: bool = False) -> Iterator[ast.AST]:
+    """Walk a function body. By default nested ``def``/``async def`` bodies
+    are skipped — their statements run in a different execution regime (a
+    sync helper offloaded to a thread is not event-loop code; each nested
+    async def is its own scope)."""
+    stack: list[ast.AST] = []
+    for stmt in fn.body:
+        if not include_nested_defs and isinstance(stmt, _FUNC_NODES):
+            continue
+        stack.append(stmt)
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if not include_nested_defs and isinstance(child, _FUNC_NODES):
+                continue
+            stack.append(child)
+
+
+def async_functions(tree: ast.Module) -> Iterator[ast.AsyncFunctionDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.AsyncFunctionDef):
+            yield node
+
+
+def _decorator_is_jit(dec: ast.AST) -> bool:
+    name = dotted_name(dec)
+    if name in JIT_NAMES:
+        return True
+    if isinstance(dec, ast.Call):
+        fname = call_name(dec)
+        if fname in JIT_NAMES:
+            return True  # @jax.jit(static_argnames=...)
+        if fname in ("functools.partial", "partial") and dec.args:
+            return dotted_name(dec.args[0]) in JIT_NAMES
+    return False
+
+
+def cached_jit_scopes(ctx) -> "list[FunctionNode]":
+    """`jit_scopes(ctx.tree)` memoized on the FileContext: two rules need
+    it and the discovery is two full AST walks."""
+    if "jit_scopes" not in ctx.cache:
+        ctx.cache["jit_scopes"] = jit_scopes(ctx.tree)
+    return ctx.cache["jit_scopes"]
+
+
+def jit_scopes(tree: ast.Module) -> list[FunctionNode]:
+    """Function defs whose bodies are traced: decorated with jax.jit/pjit
+    (directly or via functools.partial), referenced by name in a
+    ``jax.jit(f, ...)`` call (including ``self._impl`` method references),
+    or passed as the callee of a lax control-flow combinator."""
+    by_name: dict[str, list[FunctionNode]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, _FUNC_NODES):
+            by_name.setdefault(node.name, []).append(node)
+    traced: list[FunctionNode] = []
+    seen: set[int] = set()
+
+    def mark(fn: Optional[FunctionNode]) -> None:
+        if fn is not None and id(fn) not in seen:
+            seen.add(id(fn))
+            traced.append(fn)
+
+    def mark_ref(arg: ast.AST) -> None:
+        name = dotted_name(arg)
+        if name is None:
+            return
+        # `self._prefill_impl` and plain `body` both resolve by last segment.
+        for fn in by_name.get(name.rsplit(".", 1)[-1], ()):
+            mark(fn)
+
+    for node in ast.walk(tree):
+        if isinstance(node, _FUNC_NODES) and any(
+            _decorator_is_jit(d) for d in node.decorator_list
+        ):
+            mark(node)
+        elif isinstance(node, ast.Call):
+            fname = call_name(node)
+            if fname in JIT_NAMES and node.args:
+                mark_ref(node.args[0])
+            elif fname in _TRACED_CALLEE_ARGS:
+                for i in _TRACED_CALLEE_ARGS[fname]:
+                    if i < len(node.args):
+                        mark_ref(node.args[i])
+    return traced
+
+
+def jitted_callable_names(tree: ast.Module) -> set[str]:
+    """Names that invoke a jitted executable when called: jit-decorated
+    defs, plus targets of ``x = jax.jit(...)`` / ``self._x = jax.jit(...)``
+    assignments (matched as "x" / "self._x")."""
+    names: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, _FUNC_NODES) and any(
+            _decorator_is_jit(d) for d in node.decorator_list
+        ):
+            names.add(node.name)
+        elif isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if call_name(node.value) in JIT_NAMES:
+                for t in node.targets:
+                    name = dotted_name(t)
+                    if name:
+                        names.add(name)
+    return names

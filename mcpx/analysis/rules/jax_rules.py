@@ -1,0 +1,166 @@
+"""JAX/TPU hot-path hygiene rules: host-device syncs in traced scopes and
+in jitted-dispatch loops, and Python control flow on traced values."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from mcpx.analysis.core import FileContext, Finding, rule
+from mcpx.analysis.rules.common import (
+    cached_jit_scopes,
+    call_name,
+    jitted_callable_names,
+    walk_scope,
+)
+
+# Calls that force a device->host transfer (a full pipeline stall when they
+# appear inside traced code or between jitted dispatches in a hot loop).
+HOST_SYNC_CALLS = {
+    "jax.device_get",
+    "np.asarray",
+    "np.array",
+    "numpy.asarray",
+    "numpy.array",
+    "jax.block_until_ready",
+}
+HOST_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+_CONVERSIONS = {"float", "int", "bool"}
+_TRACED_CALL_PREFIXES = ("jnp.", "lax.", "jax.numpy.", "jax.lax.")
+_REDUCER_METHODS = {"any", "all"}
+
+
+def _is_host_sync(node: ast.Call) -> Optional[str]:
+    name = call_name(node)
+    if name in HOST_SYNC_CALLS:
+        return name
+    if isinstance(node.func, ast.Attribute) and node.func.attr in HOST_SYNC_METHODS:
+        return f".{node.func.attr}"
+    if (
+        isinstance(node.func, ast.Name)
+        and node.func.id in _CONVERSIONS
+        and len(node.args) == 1
+        and not isinstance(node.args[0], ast.Constant)
+    ):
+        return node.func.id
+    return None
+
+
+@rule(
+    "jit-host-sync",
+    "host-device sync inside a jitted/traced scope or a jitted-dispatch loop",
+)
+def check_jit_host_sync(ctx: FileContext) -> Iterator[Finding]:
+    tree = ctx.tree
+    # --- inside traced scopes: any host sync is a tracer leak or a stall.
+    seen: set[tuple[int, str]] = set()
+    for fn in cached_jit_scopes(ctx):
+        for node in walk_scope(fn, include_nested_defs=True):
+            if not isinstance(node, ast.Call):
+                continue
+            what = _is_host_sync(node)
+            if what is not None and (node.lineno, what) not in seen:
+                seen.add((node.lineno, what))
+                yield ctx.finding(
+                    node.lineno,
+                    "jit-host-sync",
+                    f"host sync '{what}' inside jitted scope '{fn.name}' — "
+                    "keep values on device (jnp) or move the readback "
+                    "outside the traced function",
+                )
+    # --- hot dispatch loops: float()/int()/.item() on a value returned by
+    # a jitted callable inside the same loop serializes every iteration on
+    # the device round trip. A single batched jax.device_get is the
+    # sanctioned fetch, so device_get itself is not flagged here.
+    jitted = jitted_callable_names(tree)
+    if not jitted:
+        return
+    for loop in ast.walk(tree):
+        if not isinstance(loop, (ast.For, ast.While)):
+            continue
+        device_names: set[str] = set()
+        for node in ast.walk(loop):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                if call_name(node.value) in jitted:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            device_names.add(t.id)
+                        elif isinstance(t, (ast.Tuple, ast.List)):
+                            device_names.update(
+                                e.id for e in t.elts if isinstance(e, ast.Name)
+                            )
+        if not device_names:
+            continue
+        for node in ast.walk(loop):
+            if not isinstance(node, ast.Call):
+                continue
+            arg: Optional[ast.AST] = None
+            what = None
+            if (
+                isinstance(node.func, ast.Name)
+                and node.func.id in _CONVERSIONS
+                and len(node.args) == 1
+            ):
+                arg, what = node.args[0], node.func.id
+            elif call_name(node) in ("np.asarray", "numpy.asarray") and node.args:
+                arg, what = node.args[0], call_name(node)
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in HOST_SYNC_METHODS
+            ):
+                arg, what = node.func.value, f".{node.func.attr}"
+            if (
+                arg is not None
+                and isinstance(arg, ast.Name)
+                and arg.id in device_names
+                and (node.lineno, f"loop:{what}") not in seen
+            ):
+                seen.add((node.lineno, f"loop:{what}"))
+                yield ctx.finding(
+                    node.lineno,
+                    "jit-host-sync",
+                    f"per-iteration host sync '{what}({arg.id})' on a "
+                    "jitted-call result inside a hot loop — defer or batch "
+                    "the transfer (one sync per loop, not per step)",
+                )
+
+
+def _test_mentions_traced_value(test: ast.AST) -> Optional[str]:
+    for node in ast.walk(test):
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if name is not None and name.startswith(_TRACED_CALL_PREFIXES):
+                return name
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _REDUCER_METHODS
+            ):
+                return f".{node.func.attr}"
+    return None
+
+
+@rule(
+    "traced-control-flow",
+    "Python `if`/`while` on a traced (array-valued) expression in a jitted scope",
+)
+def check_traced_control_flow(ctx: FileContext) -> Iterator[Finding]:
+    """Python control flow evaluates its test at trace time: branching on a
+    traced value raises ConcretizationTypeError at best and silently bakes
+    in one branch at worst. Flags `if`/`while` whose test computes an array
+    (jnp/lax call or .any()/.all()) inside a jitted scope; static-arg tests
+    (`if constrained:`) pass untouched."""
+    seen: set[int] = set()
+    for fn in cached_jit_scopes(ctx):
+        for node in walk_scope(fn, include_nested_defs=True):
+            if isinstance(node, (ast.If, ast.While)) and node.lineno not in seen:
+                what = _test_mentions_traced_value(node.test)
+                if what is not None:
+                    seen.add(node.lineno)
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    yield ctx.finding(
+                        node.lineno,
+                        "traced-control-flow",
+                        f"`{kind}` on traced expression ('{what}') in jitted "
+                        f"scope '{fn.name}' — use lax.cond/lax.select or "
+                        "jnp.where on device values",
+                    )

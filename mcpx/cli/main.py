@@ -163,6 +163,21 @@ def cmd_eval_planner(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_lint(args: argparse.Namespace) -> int:
+    """Run mcpxlint (mcpx/analysis/) over the given paths and diff against
+    the committed baseline. Non-zero exit on any new finding or stale
+    baseline entry — the same check tests/test_mcpxlint.py gates tier-1 on."""
+    from mcpx.analysis.cli import run_lint
+
+    return run_lint(
+        args.paths,
+        baseline=args.baseline,
+        update_baseline=args.update_baseline,
+        fmt=args.format,
+        rules=args.rule or None,
+    )
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="mcpx")
     parser.add_argument("--config", help="JSON config file")
@@ -227,6 +242,30 @@ def main(argv: list[str] | None = None) -> int:
                         help="cpu: pin to host CPU (never dials the TPU "
                         "tunnel); auto (default): whatever jax picks")
     p_eval.set_defaults(func=cmd_eval_planner)
+
+    p_lint = sub.add_parser(
+        "lint", help="static analysis (mcpxlint): async-safety + TPU hot-path rules"
+    )
+    p_lint.add_argument("paths", nargs="+", help="files or directories to scan")
+    p_lint.add_argument(
+        "--baseline",
+        default="mcpxlint.baseline.json",
+        help="baseline file of grandfathered findings (default: %(default)s)",
+    )
+    p_lint.add_argument(
+        "--update-baseline",
+        action="store_true",
+        help="rewrite the baseline from the current findings and exit 0",
+    )
+    p_lint.add_argument(
+        "--format", choices=["text", "json"], default="text",
+        help="report format (json includes run telemetry for CI)",
+    )
+    p_lint.add_argument(
+        "--rule", action="append", metavar="RULE_ID",
+        help="run only this rule (repeatable; default: all)",
+    )
+    p_lint.set_defaults(func=cmd_lint)
 
     args = parser.parse_args(argv)
     return args.func(args)

@@ -66,6 +66,7 @@ from mcpx.models.gemma.model import init_kv_cache, prefill
 from mcpx.models.gemma.params import load_or_init
 from mcpx.models.tokenizer import make_tokenizer
 from mcpx.planner.grammar import PlanGrammar, build_plan_grammar
+from mcpx.scheduler.admission import ewma_update
 from mcpx.telemetry.metrics import Metrics
 
 log = logging.getLogger("mcpx.engine")
@@ -1570,7 +1571,7 @@ class InferenceEngine:
     def _worker(self) -> None:
         try:
             self._setup()
-        except BaseException as e:  # noqa: BLE001 - surfaced to start()
+        except BaseException as e:  # mcpx: ignore[broad-except] - stored as _startup_error, surfaced via start() and /healthz
             self._startup_error = e
             self._started.set()
             return
@@ -1907,7 +1908,7 @@ class InferenceEngine:
                 temperature=slab.temperature,
                 constrained=slab.constrained,
             )
-        except BaseException as e:  # noqa: BLE001 - fail cohort AND residents
+        except BaseException as e:  # mcpx: ignore[broad-except] - fail cohort AND residents; e propagates to their futures
             # Prefill DONATES the pools: after a dispatch failure the
             # resident rows' KV may live in already-deleted buffers, so they
             # cannot continue either — fail everything and restore fresh
@@ -1989,7 +1990,7 @@ class InferenceEngine:
                 lens_d,  # still live: prefill donates only the pools
                 prev_d,
             )
-        except BaseException as e:  # noqa: BLE001 - rows already assigned
+        except BaseException as e:  # mcpx: ignore[broad-except] - rows already assigned; e propagates to every resident request future
             self._fail_rows(slab, e)
             self._reset_pools()
             return
@@ -2116,8 +2117,6 @@ class InferenceEngine:
                 # EWMA exists to feed queue_stats()'s ETA, which floors the
                 # scheduler's deadline-shed estimate — two reaction speeds
                 # for one gate would make the knob a lie.
-                from mcpx.scheduler.admission import ewma_update
-
                 self._ewma_service_s = ewma_update(
                     self._ewma_service_s,
                     (res.prefill_ms + res.decode_ms) / 1e3,

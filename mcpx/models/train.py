@@ -27,6 +27,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import os
+from collections import deque
 from dataclasses import dataclass
 from typing import Optional
 
@@ -170,7 +171,13 @@ def train(
         return jax.device_put(a, batch_sharding(a.shape[0]))
 
     B = tcfg.batch_size
-    losses: list[float] = []
+    # Device handles, not floats: float(loss) every step is a host sync that
+    # stalls the dispatch pipeline each iteration (mcpxlint jit-host-sync);
+    # keeping handles lets XLA run ahead, with one readback per log_every
+    # tick and one at the end. Only the first loss and the last 20 are ever
+    # reported, so retention is O(1), not a live buffer per step.
+    first_loss = None
+    tail_losses: "deque" = deque(maxlen=20)
     loss_log: list[tuple[int, float]] = []
     for step in range(tcfg.steps):
         take = rng.choice(train_idx, size=B, replace=len(train_idx) < B)
@@ -181,18 +188,23 @@ def train(
             _put(corpus.seq_lens[take]),
             _put(corpus.loss_mask[take]),
         )
-        losses.append(float(loss))
+        if first_loss is None:
+            first_loss = loss
+        tail_losses.append(loss)
         if tcfg.log_every and (step % tcfg.log_every == 0 or step == tcfg.steps - 1):
-            loss_log.append((step, float(loss)))
+            loss_f = float(loss)  # mcpx: ignore[jit-host-sync] - one sync per log_every tick, not per step
+            loss_log.append((step, loss_f))
             if log_fn is not None:
-                log_fn(f"step {step}/{tcfg.steps} loss {float(loss):.4f}")
+                log_fn(f"step {step}/{tcfg.steps} loss {loss_f:.4f}")
 
     report = {
-        "first_loss": losses[0],
-        "final_loss": float(np.mean(losses[-20:])),
+        "first_loss": float(first_loss),
+        "final_loss": float(np.mean([float(x) for x in tail_losses])),
         "loss_log": loss_log,
     }
     if n_eval:
+        # Accumulate ON DEVICE; one int() readback after the loop instead of
+        # two per eval batch (mcpxlint jit-host-sync).
         hits = tot = 0
         for s in range(0, n_eval, B):
             take = eval_idx[s : s + B]
@@ -202,9 +214,9 @@ def train(
                 _put(corpus.seq_lens[take]),
                 _put(corpus.loss_mask[take]),
             )
-            hits += int(h)
-            tot += int(t)
-        report["eval_token_accuracy"] = hits / max(tot, 1)
+            hits = hits + h
+            tot = tot + t
+        report["eval_token_accuracy"] = int(hits) / max(int(tot), 1)
     return params, report
 
 

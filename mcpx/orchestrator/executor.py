@@ -137,7 +137,7 @@ class Orchestrator:
         """
         try:
             return await self._run_node_inner(node, results, payload, trace)
-        except Exception as e:  # noqa: BLE001 - isolation boundary per node
+        except Exception as e:  # mcpx: ignore[broad-except] - per-node isolation boundary; error lands in the result envelope, never swallowed
             nt = trace.node(node.name, node.service)
             nt.status = "failed"
             nt.finished_at = asyncio.get_event_loop().time()

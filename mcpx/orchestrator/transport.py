@@ -80,9 +80,12 @@ class AioHttpTransport(Transport):
             raise TransportError(f"connection error calling {url}: {e}") from e
 
     async def close(self) -> None:
-        if self._session is not None:
-            await self._session.close()
-            self._session = None
+        # Detach before the await: a second close() arriving while the
+        # first is mid-await sees None instead of double-closing the same
+        # session (mcpxlint async-shared-mutation).
+        session, self._session = self._session, None
+        if session is not None:
+            await session.close()
 
 
 class LocalTransport(Transport):

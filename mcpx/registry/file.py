@@ -21,23 +21,38 @@ class FileRegistry(RegistryBackend):
         self._path = path
         self._mem = InMemoryRegistry()
         self._loaded = False
-        self._flush_lock = asyncio.Lock()
+        # One lock for both load and flush: file I/O is serialised, and the
+        # lazy first load is exactly-once even under concurrent first reads.
+        self._io_lock = asyncio.Lock()
 
     async def _ensure_loaded(self) -> None:
         if self._loaded:
             return
-        if not os.path.exists(self._path):
-            raise RegistryError(f"registry file not found: {self._path}")
-        try:
-            with open(self._path) as f:
-                data = json.load(f)
-        except (OSError, json.JSONDecodeError) as e:
-            raise RegistryError(f"cannot read registry file {self._path}: {e}") from e
-        if not isinstance(data, list):
-            raise RegistryError(f"registry file {self._path} must hold a JSON list")
-        for obj in data:
-            await self._mem.put(ServiceRecord.from_dict(obj))
-        self._loaded = True
+        # mcpxlint[async-blocking, async-shared-mutation]: the read runs off
+        # the event loop, and the lock (re-checked inside) stops two
+        # concurrent first accesses from both loading — duplicate puts would
+        # bump the registry version once per racer.
+        async with self._io_lock:
+            if self._loaded:
+                return
+            if not os.path.exists(self._path):
+                raise RegistryError(f"registry file not found: {self._path}")
+
+            def read():
+                with open(self._path) as f:
+                    return json.load(f)
+
+            try:
+                data = await asyncio.to_thread(read)
+            except (OSError, json.JSONDecodeError) as e:
+                raise RegistryError(
+                    f"cannot read registry file {self._path}: {e}"
+                ) from e
+            if not isinstance(data, list):
+                raise RegistryError(f"registry file {self._path} must hold a JSON list")
+            for obj in data:
+                await self._mem.put(ServiceRecord.from_dict(obj))
+            self._loaded = True
 
     async def get(self, name: str) -> Optional[ServiceRecord]:
         await self._ensure_loaded()
@@ -66,7 +81,7 @@ class FileRegistry(RegistryBackend):
     async def _flush(self) -> None:
         # Serialised: concurrent put/delete must not interleave temp-file
         # writes (atomic replace from a unique temp name, one at a time).
-        async with self._flush_lock:
+        async with self._io_lock:
             records = [r.to_dict() for r in await self._mem.list_services()]
 
             def write() -> None:

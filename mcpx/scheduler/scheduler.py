@@ -134,7 +134,7 @@ class Scheduler:
         if self._engine_stats is not None:
             try:
                 eng = float(self._engine_stats().get("eta_s", 0.0))
-            except Exception:  # noqa: BLE001 - an estimator must never raise
+            except Exception:  # mcpx: ignore[broad-except] - an estimator must never raise; degrades to 0 on the admission hot path
                 eng = 0.0
         return max(own, eng)
 

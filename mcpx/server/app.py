@@ -112,7 +112,7 @@ def build_app(cp: ControlPlane) -> web.Application:
                 )
             finally:
                 if limited:
-                    inflight["n"] -= 1
+                    inflight["n"] -= 1  # mcpx: ignore[async-shared-mutation] - balanced dec of the inc above; int ops don't yield, so no lost update on one loop
             status = "ok" if resp.status < 400 else "error"
             resp.headers["X-Trace-Id"] = trace_id
             return resp
@@ -259,6 +259,11 @@ def build_app(cp: ControlPlane) -> web.Application:
     # Device-side profiling (SURVEY.md §5 tracing): capture a jax.profiler
     # trace of live serving (prefill/decode/collectives) for TensorBoard /
     # Perfetto, without restarting the server.
+    # profile["dir"]: None = idle, _STARTING/_STOPPING = a trace transition
+    # in flight (a reservation no other handler may touch), any other str =
+    # active trace directory.
+    _STARTING = "<starting>"
+    _STOPPING = "<stopping>"
     profile = {"dir": None}
 
     async def profile_start(request: web.Request) -> web.Response:
@@ -272,27 +277,50 @@ def build_app(cp: ControlPlane) -> web.Application:
             import jax
         except ImportError:
             return _json_error(501, "jax unavailable; device profiling disabled")
+        # Reserve BEFORE the await: a concurrent start arriving while this
+        # one is mid-await must hit the already-active 409 above, and a
+        # concurrent STOP must see the _STARTING sentinel and back off —
+        # neither may race jax's single-session profiler state.
+        profile["dir"] = _STARTING
+        started = False
         try:
             await asyncio.to_thread(jax.profiler.start_trace, trace_dir)
-        except Exception as e:  # noqa: BLE001 - profiler state errors -> client
+            started = True
+        except Exception as e:  # mcpx: ignore[broad-except] - profiler state errors -> client as 409
             return _json_error(409, f"could not start trace: {e}")
-        profile["dir"] = trace_dir
+        finally:
+            # ALWAYS resolves the reservation — including cancellation mid-
+            # await (CancelledError skips except Exception), which would
+            # otherwise leak the sentinel and wedge both endpoints forever.
+            profile["dir"] = trace_dir if started else None  # mcpx: ignore[async-shared-mutation] - resolving this handler's own reservation; racers were 409'd by it
         return web.json_response({"profiling": "started", "dir": trace_dir})
 
     async def profile_stop(request: web.Request) -> web.Response:
         if profile["dir"] is None:
             return _json_error(409, "profiling not active")
+        if profile["dir"] in (_STARTING, _STOPPING):
+            # A start or stop is still in flight in a worker thread:
+            # dispatching stop_trace now would race it inside jax's
+            # single-session profiler state.
+            return _json_error(409, "profiler transition in progress; retry")
         import jax
 
+        # Reserve: concurrent stops (and starts) 409 on the sentinel above
+        # instead of racing the in-flight stop_trace below.
+        trace_dir, profile["dir"] = profile["dir"], _STOPPING
+        stopped = False
         try:
             # Off the event loop: stop_trace serializes the whole capture to
             # disk, which can take seconds under real decode traffic.
             await asyncio.to_thread(jax.profiler.stop_trace)
-        except Exception as e:  # noqa: BLE001
-            # Keep profile["dir"] set: jax's session state is unknown, and
-            # clearing it here would wedge both endpoints behind 409s.
+            stopped = True
+        except Exception as e:  # mcpx: ignore[broad-except] - error -> client as 500
             return _json_error(500, f"could not stop trace: {e}")
-        trace_dir, profile["dir"] = profile["dir"], None
+        finally:
+            # ALWAYS resolves the reservation (cancellation included). On
+            # failure restore the active state: jax's session is unknown,
+            # and dropping it would wedge both endpoints behind 409s.
+            profile["dir"] = None if stopped else trace_dir  # mcpx: ignore[async-shared-mutation] - resolving this handler's own reservation; racers were 409'd by it
         return web.json_response({"profiling": "stopped", "dir": trace_dir})
 
     app.router.add_post("/plan", plan)
@@ -343,11 +371,13 @@ def build_app(cp: ControlPlane) -> web.Application:
             m.cancel()
             try:
                 await m
-            except (asyncio.CancelledError, Exception):  # noqa: BLE001
-                pass
+            except asyncio.CancelledError:
+                pass  # the cancel above landing, not a failure
+            except Exception:
+                log.exception("telemetry mirror loop died with an error")
             try:
                 await cp.telemetry_mirror.aclose()
-            except Exception:  # noqa: BLE001 - best-effort at shutdown
+            except Exception:  # broad: best-effort at shutdown, and logged
                 log.exception("telemetry mirror close failed")
         t = startup_task.pop("t", None)
         if t is not None:
@@ -355,8 +385,19 @@ def build_app(cp: ControlPlane) -> web.Application:
                 t.cancel()
             try:
                 await t
-            except (asyncio.CancelledError, Exception):  # noqa: BLE001
-                pass  # failures already surface via engine.state / requests
+            except asyncio.CancelledError:
+                pass  # shutdown raced a still-warming engine; expected
+            except Exception:
+                # Startup failures already surface via engine.state and
+                # /healthz; debug-log so shutdown stays quiet but traceable.
+                log.debug("engine startup task ended with an error", exc_info=True)
+        if profile["dir"] in (_STARTING, _STOPPING):
+            # Shutdown raced an in-flight profiler transition: stopping
+            # concurrently would race that thread (an in-flight stop is
+            # already flushing the capture; an in-flight start has nothing
+            # to flush yet).
+            log.warning("shutdown during profiler transition; skipping flush")
+            profile["dir"] = None
         if profile["dir"] is not None:
             # stop_trace is what flushes the capture to disk; without this a
             # trace active at shutdown would vanish silently.
@@ -364,9 +405,9 @@ def build_app(cp: ControlPlane) -> web.Application:
 
             try:
                 await asyncio.to_thread(jax.profiler.stop_trace)
-            except Exception:  # noqa: BLE001 - best-effort at shutdown
+            except Exception:  # broad: best-effort at shutdown, and logged
                 log.exception("failed to flush active profiler trace")
-            profile["dir"] = None
+            profile["dir"] = None  # mcpx: ignore[async-shared-mutation] - shutdown path; no handler can race on_cleanup
         await cp.orchestrator.aclose()
         engine = getattr(cp.planner, "engine", None)
         if engine is not None and engine.state in ("ready", "warming"):

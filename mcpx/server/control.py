@@ -10,6 +10,7 @@ registries (SURVEY.md §5 checkpoint/resume).
 
 from __future__ import annotations
 
+import logging
 import time
 from collections import OrderedDict
 from typing import Any, Optional
@@ -24,6 +25,8 @@ from mcpx.registry.base import RegistryBackend
 from mcpx.telemetry.metrics import Metrics
 from mcpx.telemetry.replan import ReplanPolicy
 from mcpx.telemetry.stats import TelemetryStore
+
+log = logging.getLogger("mcpx.control")
 
 
 class ControlPlane:
@@ -76,10 +79,8 @@ class ControlPlane:
         if warm is not None:
             try:
                 await warm(self.registry)
-            except Exception:  # noqa: BLE001 - warm is best-effort
-                import logging
-
-                logging.getLogger("mcpx.control").exception(
+            except Exception:  # broad: warm is best-effort, and logged
+                log.exception(
                     "registry-grammar warmup failed; first plan pays the compile"
                 )
 
@@ -208,7 +209,12 @@ class ControlPlane:
             try:
                 plan = await self.planner.plan(intent, context)
             except Exception:
-                break  # nothing viable left to route around; keep last result
+                # Nothing viable left to route around; keep the last result
+                # — but say so, or a planner crash mid-replan is invisible.
+                log.exception(
+                    "replan attempt %d failed; keeping last result", trace.replans
+                )
+                break
             result = await self.execute(plan, payload, trace)
         if trace.replans and result.status == "ok":
             # The repaired plan is the one worth caching — in EVERY enabled

@@ -1,0 +1,25 @@
+"""Fixture: nothing here may trigger async-blocking."""
+
+import asyncio
+import time
+
+
+def sync_helper(path):
+    time.sleep(0.1)  # sync function: its caller decides the regime
+    with open(path) as f:
+        return f.read()
+
+
+async def polite(path):
+    await asyncio.sleep(0.1)
+    return await asyncio.to_thread(sync_helper, path)
+
+
+async def offloaded(path):
+    # A nested *sync* def is a different execution regime (to_thread target):
+    # its body must not be charged to the enclosing coroutine.
+    def read():
+        with open(path) as f:
+            return f.read()
+
+    return await asyncio.to_thread(read)

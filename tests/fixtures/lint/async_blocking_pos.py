@@ -1,0 +1,27 @@
+"""Fixture: every call here must trigger async-blocking."""
+
+import subprocess
+import time
+
+import requests
+
+
+async def sleepy():
+    time.sleep(1.0)  # line 10: blocking sleep
+
+
+async def reads_file(path):
+    with open(path) as f:  # line 14: sync open
+        return f.read()
+
+
+async def shells_out():
+    subprocess.run(["ls"])  # line 19: sync subprocess
+
+
+async def fetches(url):
+    return requests.get(url)  # line 23: sync HTTP
+
+
+async def pathlib_io(p):
+    return p.read_text()  # line 27: blocking filesystem method

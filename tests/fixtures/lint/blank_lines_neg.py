@@ -1,0 +1,10 @@
+"""Fixture: two consecutive blank lines are fine; nothing triggers."""
+
+A = 1
+
+
+B = 2
+
+
+def f():
+    return A + B

@@ -1,0 +1,7 @@
+"""Fixture: the gap below must trigger blank-lines."""
+
+A = 1
+
+
+
+B = 2

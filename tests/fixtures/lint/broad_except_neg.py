@@ -1,0 +1,46 @@
+"""Fixture: nothing here may trigger broad-except."""
+
+import logging
+import traceback
+
+log = logging.getLogger(__name__)
+
+
+def narrow():
+    try:
+        risky()
+    except ValueError:  # specific type: fine even when silent
+        return None
+
+
+def logs_it():
+    try:
+        risky()
+    except Exception:
+        log.exception("risky failed; continuing")
+
+
+def logs_via_get_logger():
+    try:
+        risky()
+    except Exception as e:
+        logging.getLogger("fixture").warning("risky failed: %s", e)
+
+
+def reraises():
+    try:
+        risky()
+    except Exception:
+        raise
+
+
+def prints_traceback():
+    try:
+        risky()
+    except Exception:
+        traceback.print_exc()
+        return None
+
+
+def risky():
+    raise ValueError("boom")

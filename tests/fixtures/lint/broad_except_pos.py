@@ -1,0 +1,33 @@
+"""Fixture: every handler here must trigger broad-except."""
+
+
+def swallow():
+    try:
+        risky()
+    except Exception:  # line 7: silent swallow
+        pass
+
+
+def bare():
+    try:
+        risky()
+    except:  # noqa: E722  # line 14: bare except, silent
+        return None
+
+
+def tuple_broad():
+    try:
+        risky()
+    except (ValueError, Exception):  # line 21: Exception hides in a tuple
+        return -1
+
+
+def base_exception():
+    try:
+        risky()
+    except BaseException:  # line 28: even broader, still silent
+        return None
+
+
+def risky():
+    raise ValueError("boom")

@@ -1,0 +1,38 @@
+"""Fixture: nothing here may trigger jit-host-sync."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+@jax.jit
+def clean(x):
+    y = jnp.asarray(x)  # jnp stays on device
+    return jnp.sum(y * 2.0)
+
+
+def _scan_body(carry, x):
+    return carry + x, jnp.where(x > 0, x, 0)
+
+
+def uses_scan(xs):
+    return lax.scan(_scan_body, jnp.asarray(0.0), xs)
+
+
+def host_prep(rows):
+    # np conversions OUTSIDE any traced scope are ordinary host work.
+    arr = np.asarray(rows)
+    return int(arr.sum())
+
+
+step = jax.jit(lambda p, b: p)
+
+
+def batched_fetch_loop(params, batches):
+    outs = []
+    for b in batches:
+        params = step(params, b)
+        outs.append(params)  # keep handles; no per-step conversion
+    # ONE sync after the loop is the sanctioned pattern.
+    return [float(jnp.sum(o)) for o in outs]

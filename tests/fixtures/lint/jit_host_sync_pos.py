@@ -1,0 +1,40 @@
+"""Fixture: every marked line must trigger jit-host-sync."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+@jax.jit
+def decorated(x):
+    y = np.asarray(x)  # line 13: host transfer inside jit
+    return float(x.sum()) + y.item()  # line 14: float() and .item()
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def partial_decorated(x, n):
+    jax.device_get(x)  # line 19: device_get inside jit
+    return x * n
+
+
+def _scan_body(carry, x):
+    v = int(x)  # line 24: int() on traced scan input
+    return carry + v, x
+
+
+def uses_scan(xs):
+    return lax.scan(_scan_body, 0, xs)
+
+
+step = jax.jit(lambda p, b: p)
+
+
+def hot_loop(params, batches):
+    for b in batches:
+        params = step(params, b)
+        loss = step(params, b)
+        print(float(loss))  # line 39: per-iteration sync on jitted result
+    return params

@@ -1,0 +1,31 @@
+"""Fixture: nothing here may trigger async-shared-mutation."""
+
+import asyncio
+
+
+class Locked:
+    def __init__(self):
+        self._ready = False
+        self._lock = asyncio.Lock()
+        self._session = None
+
+    async def ensure(self):
+        # Check-then-act under a lock: the await is inside the guard.
+        async with self._lock:
+            if self._ready:
+                return
+            await self._load()
+            self._ready = True
+
+    async def close(self):
+        # Detach-before-await: the write happens before any yield point.
+        session, self._session = self._session, None
+        if session is not None:
+            await session.close()
+
+    def sync_toggle(self):
+        # Sync method: no event-loop interleaving to worry about.
+        self._ready = not self._ready
+
+    async def _load(self):
+        pass

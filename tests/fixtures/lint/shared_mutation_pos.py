@@ -1,0 +1,27 @@
+"""Fixture: both writes here must trigger async-shared-mutation."""
+
+counts = {"n": 0}
+
+
+class LazyLoader:
+    def __init__(self):
+        self._ready = False
+
+    async def ensure(self):
+        if self._ready:  # check ...
+            return
+        await self._load()  # ... yield point: another task re-enters ...
+        self._ready = True  # line 14: ... then act — classic lost race
+
+    async def _load(self):
+        pass
+
+
+async def handler():
+    counts["n"] += 1
+    await do_work()
+    counts["n"] -= 1  # line 23: dict counter mutated across the await
+
+
+async def do_work():
+    pass

@@ -1,0 +1,11 @@
+"""Fixture: one real suppression (consumed) and one dead one (reported)."""
+
+import time
+
+
+async def tolerated():
+    time.sleep(0.01)  # mcpx: ignore[async-blocking] - fixture: justified one-off
+
+
+async def clean():
+    return 42  # mcpx: ignore[async-blocking] - nothing to suppress: dead annotation

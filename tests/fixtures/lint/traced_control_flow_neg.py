@@ -1,0 +1,22 @@
+"""Fixture: nothing here may trigger traced-control-flow."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def static_branches(x, chunk: int, constrained: bool):
+    # Static-argument control flow is resolved at trace time — fine.
+    if chunk > 1 and constrained:
+        x = x * chunk
+    while chunk > 4:
+        chunk -= 1
+    return jnp.where(x > 0, x, 0)  # device-side select, not Python flow
+
+
+def host_code(rows):
+    # Outside any traced scope, branching on array reductions is ordinary
+    # (eager) numpy-style code.
+    if jnp.any(jnp.asarray(rows) > 0):
+        return True
+    return False

@@ -1,0 +1,18 @@
+"""Fixture: both branches here must trigger traced-control-flow."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def branches_on_array(x):
+    if jnp.any(x > 0):  # line 9: Python `if` on a traced value
+        return x * 2
+    return x
+
+
+@jax.jit
+def loops_on_array(x):
+    while x.any():  # line 16: Python `while` on a traced reduction
+        x = x - 1
+    return x

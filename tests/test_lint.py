@@ -1,21 +1,19 @@
-"""Repo-local source hygiene checks (ADVICE r5): no runs of >= 3
-consecutive blank lines may land in mcpx/ or benchmarks/ — the residue
-editing sessions leave behind when deleting blocks."""
+"""Blank-line hygiene (ADVICE r5), now served by mcpxlint: the standalone
+regex lives in mcpx/analysis/rules/style_rules.py as the `blank-lines`
+rule; this test is a thin wrapper keeping the original tier-1 contract —
+no runs of >= 3 consecutive blank lines land in mcpx/ or benchmarks/."""
 
 import pathlib
-import re
+
+from mcpx.analysis import scan_paths
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
 
-_BLANK_RUN = re.compile(r"(?:^[ \t]*\n){3,}", re.MULTILINE)
-
 
 def test_no_blank_line_runs():
-    bad: list[str] = []
-    for root in ("mcpx", "benchmarks"):
-        for path in sorted((REPO / root).rglob("*.py")):
-            text = path.read_text()
-            for m in _BLANK_RUN.finditer(text):
-                line = text[: m.start()].count("\n") + 1
-                bad.append(f"{path.relative_to(REPO)}:{line}")
-    assert not bad, f"runs of >=3 consecutive blank lines: {bad}"
+    res = scan_paths(
+        [REPO / "mcpx", REPO / "benchmarks"], root=REPO, rules=["blank-lines"]
+    )
+    assert not res.findings, "runs of >=3 consecutive blank lines: " + ", ".join(
+        f"{f.path}:{f.line}" for f in res.findings
+    )

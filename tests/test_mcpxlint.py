@@ -1,0 +1,259 @@
+"""mcpxlint (mcpx/analysis/): per-rule fixture coverage, suppression and
+baseline semantics, CLI behavior, and the tier-1 gate that runs the full
+analyzer over mcpx/ + benchmarks/ against the committed baseline."""
+
+import io
+import json
+import pathlib
+
+import pytest
+
+from mcpx.analysis import (
+    all_rules,
+    apply_baseline,
+    load_baseline,
+    save_baseline,
+    scan_paths,
+)
+from mcpx.analysis.cli import run_lint
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+FIXTURES = REPO / "tests" / "fixtures" / "lint"
+BASELINE = REPO / "mcpxlint.baseline.json"
+
+RULE_IDS = {
+    "async-blocking",
+    "async-shared-mutation",
+    "jit-host-sync",
+    "traced-control-flow",
+    "broad-except",
+    "blank-lines",
+}
+
+
+def hits(fixture: str, rule: str) -> list[int]:
+    """Sorted finding lines for one rule over one fixture file."""
+    res = scan_paths([FIXTURES / fixture], root=REPO, rules=[rule])
+    return sorted(f.line for f in res.findings if f.rule == rule)
+
+
+# ------------------------------------------------------------------ registry
+def test_registry_has_all_rules():
+    assert RULE_IDS <= set(all_rules())
+
+
+def test_unknown_rule_id_rejected():
+    with pytest.raises(ValueError, match="unknown rule"):
+        scan_paths([FIXTURES], rules=["no-such-rule"])
+
+
+# ------------------------------------------------------------------ fixtures
+def test_async_blocking_positive():
+    assert hits("async_blocking_pos.py", "async-blocking") == [10, 14, 19, 23, 27]
+
+
+def test_async_blocking_negative():
+    assert hits("async_blocking_neg.py", "async-blocking") == []
+
+
+def test_jit_host_sync_positive():
+    lines = hits("jit_host_sync_pos.py", "jit-host-sync")
+    assert set(lines) == {13, 14, 19, 24, 39}
+    # line 14 carries TWO syncs (float() and .item())
+    assert lines.count(14) == 2
+
+
+def test_jit_host_sync_negative():
+    assert hits("jit_host_sync_neg.py", "jit-host-sync") == []
+
+
+def test_traced_control_flow_positive():
+    assert hits("traced_control_flow_pos.py", "traced-control-flow") == [9, 16]
+
+
+def test_traced_control_flow_negative():
+    assert hits("traced_control_flow_neg.py", "traced-control-flow") == []
+
+
+def test_broad_except_positive():
+    assert hits("broad_except_pos.py", "broad-except") == [7, 14, 21, 28]
+
+
+def test_broad_except_negative():
+    assert hits("broad_except_neg.py", "broad-except") == []
+
+
+def test_shared_mutation_positive():
+    assert hits("shared_mutation_pos.py", "async-shared-mutation") == [14, 23]
+
+
+def test_shared_mutation_negative():
+    assert hits("shared_mutation_neg.py", "async-shared-mutation") == []
+
+
+def test_blank_lines_positive():
+    assert hits("blank_lines_pos.py", "blank-lines") == [4]
+
+
+def test_blank_lines_negative():
+    assert hits("blank_lines_neg.py", "blank-lines") == []
+
+
+# -------------------------------------------------------------- suppressions
+def test_suppression_consumes_finding_and_dead_one_is_reported():
+    res = scan_paths([FIXTURES / "suppressed.py"], root=REPO)
+    assert res.suppressed == 1  # the justified time.sleep
+    assert [f.rule for f in res.findings] == ["unused-suppression"]
+    assert res.findings[0].line == 11
+
+
+def test_suppression_only_judged_against_selected_rules():
+    # A blank-lines-only pass must not call the async-blocking suppression
+    # unused — that rule never ran.
+    res = scan_paths([FIXTURES / "suppressed.py"], root=REPO, rules=["blank-lines"])
+    assert res.findings == []
+
+
+# ------------------------------------------------------------------ baseline
+def test_baseline_roundtrip_match_and_stale(tmp_path):
+    res = scan_paths([FIXTURES / "broad_except_pos.py"], root=REPO)
+    findings = [f for f in res.findings if f.rule == "broad-except"]
+    assert findings
+    path = tmp_path / "base.json"
+    save_baseline(path, findings)
+    entries = load_baseline(path)
+    new, baselined, stale = apply_baseline(findings, entries)
+    assert (new, baselined, stale) == ([], len(findings), [])
+    # Deleting one entry resurfaces exactly that finding...
+    new, _, stale = apply_baseline(findings, entries[1:])
+    assert len(new) == 1 and not stale
+    assert new[0].key == (entries[0]["path"], entries[0]["rule"], entries[0]["line"])
+    # ...and an entry with no matching finding is stale.
+    extra = dict(entries[0], line=9999)
+    _, _, stale = apply_baseline(findings, entries + [extra])
+    assert stale == [extra]
+
+
+def test_missing_baseline_is_empty(tmp_path):
+    assert load_baseline(tmp_path / "absent.json") == []
+
+
+# ----------------------------------------------------------------------- cli
+def test_cli_exit_codes_and_update(tmp_path):
+    target = FIXTURES / "broad_except_pos.py"
+    base = tmp_path / "b.json"
+    out = io.StringIO()
+    # Dirty tree, empty baseline -> 1, findings printed as path:line rule msg
+    assert run_lint([str(target)], baseline=str(base), root=str(REPO), out=out) == 1
+    line = out.getvalue().splitlines()[0]
+    assert line.startswith("tests/fixtures/lint/broad_except_pos.py:7 broad-except ")
+    # Update, then the same scan is clean
+    assert run_lint(
+        [str(target)], baseline=str(base), update_baseline=True,
+        root=str(REPO), out=io.StringIO(),
+    ) == 0
+    assert run_lint([str(target)], baseline=str(base), root=str(REPO),
+                    out=io.StringIO()) == 0
+    # Deleting one baseline entry -> non-zero again
+    data = json.loads(base.read_text())
+    data["entries"] = data["entries"][1:]
+    base.write_text(json.dumps(data))
+    assert run_lint([str(target)], baseline=str(base), root=str(REPO),
+                    out=io.StringIO()) == 1
+    # A stale entry alone -> non-zero too
+    data = json.loads(base.read_text())
+    data["entries"] = [dict(data["entries"][0], line=9999)] + data["entries"]
+    base.write_text(json.dumps(data))
+    assert run_lint([str(target)], baseline=str(base), root=str(REPO),
+                    out=io.StringIO()) == 1
+
+
+def test_cli_json_format(tmp_path):
+    out = io.StringIO()
+    code = run_lint(
+        [str(FIXTURES / "async_blocking_pos.py")],
+        baseline=str(tmp_path / "none.json"),
+        fmt="json",
+        root=str(REPO),
+        out=out,
+    )
+    payload = json.loads(out.getvalue())
+    assert code == 1 and payload["exit"] == 1
+    assert payload["counts_by_rule"]["async-blocking"] == 5
+    assert payload["files_scanned"] == 1
+    assert {f["rule"] for f in payload["new"]} == {"async-blocking"}
+    assert all({"path", "line", "rule", "message"} <= set(f) for f in payload["new"])
+
+
+def test_cli_unknown_rule_is_a_usage_error_not_a_crash(tmp_path):
+    out = io.StringIO()
+    code = run_lint(
+        [str(FIXTURES / "blank_lines_neg.py")],
+        baseline=str(tmp_path / "b.json"),
+        rules=["no-such-rule"],
+        root=str(REPO),
+        out=out,
+    )
+    assert code == 2
+    assert "unknown rule" in out.getvalue()
+
+
+def test_cli_malformed_baseline_is_a_usage_error_not_a_crash(tmp_path):
+    base = tmp_path / "b.json"
+    for bad in ('{"entries": [{"path": "x"}]}', "{truncated"):
+        base.write_text(bad)
+        out = io.StringIO()
+        code = run_lint(
+            [str(FIXTURES / "blank_lines_neg.py")],
+            baseline=str(base), root=str(REPO), out=out,
+        )
+        assert code == 2
+        assert "cannot read baseline" in out.getvalue()
+
+
+def test_cli_filtered_update_preserves_other_rules_entries(tmp_path):
+    base = tmp_path / "b.json"
+    target = FIXTURES / "suppressed.py"  # has 1 async-blocking (suppressed)
+    # Seed the baseline with a foreign rule's entry...
+    save_baseline(
+        base,
+        scan_paths([FIXTURES / "broad_except_pos.py"], root=REPO).findings,
+    )
+    before = load_baseline(base)
+    assert {e["rule"] for e in before} == {"broad-except"}
+    # ...then a --rule blank-lines --update-baseline over another file must
+    # not wipe it.
+    assert run_lint(
+        [str(target)], baseline=str(base), update_baseline=True,
+        rules=["blank-lines"], root=str(REPO), out=io.StringIO(),
+    ) == 0
+    assert load_baseline(base) == before
+
+
+def test_cli_subcommand_wiring():
+    from mcpx.cli.main import main
+
+    # (an absent baseline is empty — the committed one would read as stale
+    # against a single-fixture scan, by design)
+    assert main(["lint", str(FIXTURES / "blank_lines_neg.py"),
+                 "--baseline", str(REPO / "does-not-exist.json")]) == 0
+    assert main(["lint", str(FIXTURES / "blank_lines_pos.py"),
+                 "--baseline", str(REPO / "does-not-exist.json")]) == 1
+
+
+# ----------------------------------------------------------- tier-1 gate
+def test_tree_is_clean_against_committed_baseline():
+    """THE gate: the full analyzer over mcpx/ + benchmarks/ must report
+    nothing beyond the committed baseline, and every baseline entry must
+    still match a live finding (no stale grandfathering)."""
+    res = scan_paths([REPO / "mcpx", REPO / "benchmarks"], root=REPO)
+    entries = load_baseline(BASELINE)
+    new, _, stale = apply_baseline(res.findings, entries)
+    assert not new, "new findings:\n" + "\n".join(f.render() for f in new)
+    assert not stale, f"stale baseline entries (delete them): {stale}"
+
+
+def test_committed_baseline_stays_small():
+    # The baseline is a burn-down list, not a dumping ground: additions
+    # need a better reason than "the analyzer complained".
+    assert len(load_baseline(BASELINE)) <= 10
