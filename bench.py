@@ -39,6 +39,17 @@ Environment knobs:
     MCPX_BENCH_LATENCY_REQUESTS  phase-2 request count (default 192)
     MCPX_BENCH_PALLAS    0 = fused-jnp attention even on TPU (smoke ladder)
     MCPX_BENCH_OVERLOAD  0 skips the scheduler overload phase (default on)
+    MCPX_BENCH_MIXED     0 skips the heterogeneous mixed-traffic phase
+                         (default on): constrained/free-form + two
+                         temperatures + two grammars, served closed-loop
+                         with engine.hetero_batch on vs off at the same
+                         offered load — reports mixed_plans_per_sec per
+                         mode, the speedup, HoL-wait p99 and degraded_share
+    MCPX_BENCH_MIXED_REQUESTS     mixed-phase request count (default 96)
+    MCPX_BENCH_MIXED_TEMPERATURE  the phase's hot sampling temperature (0.7)
+    MCPX_BENCH_HETERO    1 = serve the HEADLINE phases with
+                         engine.hetero_batch on too (default 0 keeps the
+                         headline comparable to earlier rounds)
     MCPX_BENCH_OVERLOAD_FACTOR    offered load as a multiple of measured
                                   throughput (default 4)
     MCPX_BENCH_OVERLOAD_REQUESTS  overload-phase request count (default 256)
@@ -244,6 +255,10 @@ def _build_config(model_size: str):
                 # a first-ever hardware Mosaic compile of the paged kernel,
                 # and the smoke ladder uses this knob to tell them apart.
                 "use_pallas": _pallas_on(),
+                # Headline-phase heterogeneous batching (the mixed phase
+                # flips the flag per mode regardless): default off so the
+                # headline numbers stay comparable to earlier rounds.
+                "hetero_batch": os.environ.get("MCPX_BENCH_HETERO", "0") == "1",
                 # Compile every (A, T) bucket before serving: the timed
                 # region must contain zero XLA compiles. MCPX_BENCH_WARMUP=0
                 # skips it for CPU smoke runs (a virtual-CPU fallback pays
@@ -285,10 +300,19 @@ def _parse_prom(text: str) -> dict[str, float]:
     return out
 
 
-def _hist_p50(prom: dict[str, float], name: str, prom_base: dict[str, float] | None = None) -> float:
-    """Approximate p50 (ms) from a histogram's cumulative buckets. With
-    ``prom_base``, buckets are delta'd so only observations between the two
-    scrapes count (warmup must not contaminate the timed-phase split)."""
+def _hist_quantile(
+    prom: dict[str, float],
+    name: str,
+    q: float,
+    prom_base: dict[str, float] | None = None,
+    scale: float = 1e3,
+) -> float:
+    """Approximate quantile ``q`` from a histogram's cumulative buckets,
+    linearly interpolated within the landing bucket. With ``prom_base``,
+    buckets are delta'd so only observations between the two scrapes count
+    (warmup must not contaminate the timed-phase split). ``scale`` converts
+    bucket units to the reported unit (1e3 for seconds->ms histograms; 1.0
+    for the ms-native ``mcpx_engine_hol_wait_ms``)."""
     buckets = []
     for k, v in prom.items():
         m = re.match(rf'^{re.escape(name)}_bucket\{{le="([^"]+)"\}}$', k)
@@ -299,16 +323,21 @@ def _hist_p50(prom: dict[str, float], name: str, prom_base: dict[str, float] | N
     total = buckets[-1][1] if buckets else 0
     if total <= 0:
         return 0.0
-    half = total / 2.0
+    target = total * q
     prev_le, prev_n = 0.0, 0.0
     for le, n in buckets:
-        if n >= half:
+        if n >= target:
             if le == float("inf"):
-                return prev_le * 1e3
-            frac = (half - prev_n) / max(1e-9, n - prev_n)
-            return (prev_le + frac * (le - prev_le)) * 1e3
+                return prev_le * scale
+            frac = (target - prev_n) / max(1e-9, n - prev_n)
+            return (prev_le + frac * (le - prev_le)) * scale
         prev_le, prev_n = le, n
     return 0.0
+
+
+def _hist_p50(prom: dict[str, float], name: str, prom_base: dict[str, float] | None = None) -> float:
+    """p50 (ms) of a seconds-bucketed histogram (see ``_hist_quantile``)."""
+    return _hist_quantile(prom, name, 0.5, prom_base)
 
 
 _TRAINED_CKPT = os.path.join(
@@ -531,6 +560,125 @@ async def _overload_phase(cp, base: str, records, rng, plans_per_sec: float) -> 
     }
 
 
+async def _mixed_phase(cp, overload: "dict | None") -> "dict | None":
+    """Heterogeneous-batching scenario (ISSUE 3 acceptance): offer the
+    ENGINE a steady mixed stream — grammar-constrained next to free-form,
+    two temperatures, two grammars — closed-loop, and serve it twice at the
+    same offered load: once with ``hetero_batch`` on (per-row sampling +
+    stacked DFAs, strict queue-order admission) and once off (the
+    homogeneous slab whose drain-to-switch ping-pongs the batch between
+    configurations). Direct ``engine.generate`` calls: the /plan HTTP path
+    pins one sampling config, and this phase exists to measure the mix.
+    The flag flips on the LIVE engine between modes (both executables
+    coexist; the flip happens only while the slab is idle, and each mode
+    gets an untimed warm round so no XLA compile lands in its timed
+    region). Reports ``mixed_plans_per_sec`` per mode, the speedup, the
+    head-of-line wait p99 scraped from ``mcpx_engine_hol_wait_ms``, and
+    echoes the overload phase's ``degraded_share`` so the three
+    degradation-facing numbers sit together. Skip with MCPX_BENCH_MIXED=0."""
+    if os.environ.get("MCPX_BENCH_MIXED", "1") == "0":
+        return None
+    engine = getattr(cp.planner, "engine", None)
+    if engine is None or engine.state != "ready":
+        return None
+    from mcpx.planner.grammar import build_plan_grammar
+
+    n = int(os.environ.get("MCPX_BENCH_MIXED_REQUESTS", "96"))
+    hot = float(os.environ.get("MCPX_BENCH_MIXED_TEMPERATURE", "0.7"))
+    tok = engine.tokenizer
+    ecfg = engine.config.engine
+    concurrency = min(2 * ecfg.max_batch_size, 64)
+    budget = max(8, min(24, ecfg.max_decode_len))
+    g_alt = build_plan_grammar(
+        tok, ["mixed-rank-svc", "mixed-sum-svc", "mixed-etl-svc"]
+    )
+    # (constrained, temperature, grammar): the interleave a real control
+    # plane serves — greedy /plan, sampled free-form, a second grammar,
+    # sampled /plan. Round-robin so every slab admission sees the mix.
+    classes = [
+        (True, 0.0, None),
+        (False, hot, None),
+        (True, 0.0, g_alt),
+        (True, hot, None),
+        (False, 0.0, None),
+    ]
+
+    async def _idle() -> None:
+        while engine._slab.n_active or engine._queue.qsize():
+            await asyncio.sleep(0.05)
+        await asyncio.sleep(0.1)
+
+    async def one(i: int, sem: asyncio.Semaphore) -> None:
+        constrained, temp, grammar = classes[i % len(classes)]
+        prompt = tok.encode(f"mixed intent {i}: compose the services. JSON:")
+        async with sem:
+            await engine.generate(
+                prompt,
+                max_new_tokens=budget,
+                constrained=constrained,
+                temperature=temp,
+                grammar=grammar,
+            )
+
+    async def run_mode(hetero: bool) -> dict:
+        await _idle()
+        ecfg.hetero_batch = hetero
+        # Untimed warm round at the SAME concurrency as the timed run: the
+        # first timed admission drains up to `concurrency` pending requests
+        # into one cohort, so warming with fewer would leave that cohort's
+        # (A, T) admit executables to compile INSIDE the timed region and
+        # contaminate mixed_plans_per_sec/HoL for whichever mode ran first.
+        n_warm = max(len(classes), concurrency)
+        warm_sem = asyncio.Semaphore(concurrency)
+        await asyncio.gather(*(one(i, warm_sem) for i in range(n_warm)))
+        await _idle()
+        prom0 = _parse_prom(cp.metrics.render().decode())
+        sem = asyncio.Semaphore(concurrency)
+        t0 = time.monotonic()
+        await asyncio.gather(*(one(i, sem) for i in range(n)))
+        elapsed = time.monotonic() - t0
+        prom1 = _parse_prom(cp.metrics.render().decode())
+        return {
+            "mixed_plans_per_sec": round(n / max(1e-9, elapsed), 2),
+            "hol_p99_ms": round(
+                _hist_quantile(
+                    prom1, "mcpx_engine_hol_wait_ms", 0.99, prom0, scale=1.0
+                ),
+                1,
+            ),
+            "hol_p50_ms": round(
+                _hist_quantile(
+                    prom1, "mcpx_engine_hol_wait_ms", 0.5, prom0, scale=1.0
+                ),
+                1,
+            ),
+        }
+
+    prev = ecfg.hetero_batch
+    try:
+        drain = await run_mode(False)
+        hetero = await run_mode(True)
+    finally:
+        await _idle()
+        ecfg.hetero_batch = prev
+    return {
+        "requests": n,
+        "concurrency": concurrency,
+        "classes": len(classes),
+        "hot_temperature": hot,
+        "hetero": hetero,
+        "drain": drain,
+        "speedup": round(
+            hetero["mixed_plans_per_sec"] / max(1e-9, drain["mixed_plans_per_sec"]),
+            3,
+        ),
+        # The scheduler-overload degradation share, echoed so the three
+        # degradation-facing numbers (mixed throughput, HoL wait, degraded
+        # share) read together in one place.
+        "degraded_share": overload.get("degraded_share") if overload else None,
+    }
+
+
 async def _run(model_size: str, n_requests: int, concurrency: int, n_services: int) -> dict:
     from aiohttp import ClientSession, TCPConnector
     from aiohttp.test_utils import TestServer
@@ -713,6 +861,11 @@ async def _run(model_size: str, n_requests: int, concurrency: int, n_services: i
         # headline scrape so attaching the scheduler cannot perturb them.
         overload = await _overload_phase(cp, base, records, rng, plans_per_sec)
 
+        # ---- Phase 4: heterogeneous mixed-traffic (ISSUE 3) — last, so
+        # flipping hetero_batch on the live engine can't touch any earlier
+        # number.
+        mixed = await _mixed_phase(cp, overload)
+
     finally:
         # Teardown in a FINALLY: a cancelled run (MCPX_BENCH_RUN_TIMEOUT_S
         # hang-guard) must not leak the engine HBM + TestServer into the
@@ -775,6 +928,10 @@ async def _run(model_size: str, n_requests: int, concurrency: int, n_services: i
         # degraded-share, admitted p50 vs the configured SLO at >= 4x the
         # measured sustainable rate.
         "overload": overload,
+        # Heterogeneous mixed-traffic scenario (None when skipped):
+        # mixed_plans_per_sec hetero vs drain at the same offered load,
+        # head-of-line wait p99, degraded_share.
+        "mixed": mixed,
         "plan_quality": quality,
         "plans_per_sec": plans_per_sec,
         "p50_ms": statistics.median(open_sorted),
@@ -1069,6 +1226,7 @@ def main() -> None:
                 "requests": n_requests,
                 "errors": stats["errors"],
                 "overload": stats["overload"],
+                "mixed": stats["mixed"],
                 "grammar_fallback": stats["grammar_fallback"],
                 "cache_hit_share": round(stats["cache_hit_share"], 4),
                 "unique_intents": stats["unique_intents"],

@@ -8,8 +8,10 @@ from typing import Iterator, Optional
 
 from mcpx.analysis.core import FileContext, Finding, rule
 from mcpx.analysis.rules.common import (
+    JIT_NAMES,
     cached_jit_scopes,
     call_name,
+    dotted_name,
     jitted_callable_names,
     walk_scope,
 )
@@ -122,6 +124,133 @@ def check_jit_host_sync(ctx: FileContext) -> Iterator[Finding]:
                     f"per-iteration host sync '{what}({arg.id})' on a "
                     "jitted-call result inside a hot loop — defer or batch "
                     "the transfer (one sync per loop, not per step)",
+                )
+
+
+def _static_argnames(call: ast.Call) -> set[str]:
+    """Literal static_argnames of a jit call/decorator ({} when absent or
+    not statically readable)."""
+    for kw in call.keywords:
+        if kw.arg != "static_argnames":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            return {v.value}
+        if isinstance(v, (ast.Tuple, ast.List)):
+            return {
+                e.value
+                for e in v.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)
+            }
+        return set()
+    return set()
+
+
+def _param_names(fn) -> set[str]:
+    a = fn.args
+    return {p.arg for p in a.posonlyargs + a.args + a.kwonlyargs}
+
+
+def _branch_names(test: ast.AST) -> set[str]:
+    """Bare names a branch test depends on, minus two static-at-trace-time
+    idioms: `is`/`is not` operands (``if mask is not None:`` branches on
+    argument PRESENCE) and names used only through an attribute access
+    (``if x.ndim == 2:``, ``if x.shape[0] > 1:`` — shape/dtype metadata is
+    static; value-producing attributes like ``.any()`` are the
+    traced-control-flow rule's business)."""
+    skip: set[str] = set()
+    for node in ast.walk(test):
+        if isinstance(node, ast.Compare) and any(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops
+        ):
+            for sub in [node.left, *node.comparators]:
+                if isinstance(sub, ast.Name):
+                    skip.add(sub.id)
+        elif isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
+            skip.add(node.value.id)
+    return {
+        n.id for n in ast.walk(test) if isinstance(n, ast.Name)
+    } - skip
+
+
+@rule(
+    "jit-static-branch",
+    "Python `if`/`while` on a jitted function's parameter that is not in "
+    "static_argnames",
+)
+def check_jit_static_branch(ctx: FileContext) -> Iterator[Finding]:
+    """A Python branch on a traced PARAMETER evaluates at trace time:
+    ConcretizationTypeError at best, one branch silently baked into the
+    executable at worst — the exact bug class a refactor that moves a
+    static arg (temperature, a constrained flag) into per-row device state
+    can introduce. Flags `if`/`while` whose test uses a parameter of a
+    jitted function that is NOT listed in its static_argnames; `is (not)
+    None` presence checks and names shadowed by nested-def parameters are
+    exempt."""
+    tree = ctx.tree
+    by_name: dict[str, list] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            by_name.setdefault(node.name, []).append(node)
+    # id(fn) -> (fn, union of statically-declared static_argnames)
+    targets: dict[int, tuple] = {}
+
+    def note(fn, statics: set[str]) -> None:
+        prev = targets.get(id(fn))
+        targets[id(fn)] = (fn, (prev[1] if prev else set()) | statics)
+
+    def note_ref(arg: ast.AST, statics: set[str]) -> None:
+        name = dotted_name(arg)
+        if name is None:
+            return
+        for fn in by_name.get(name.rsplit(".", 1)[-1], ()):
+            note(fn, statics)
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            if call_name(node) in JIT_NAMES and node.args:
+                note_ref(node.args[0], _static_argnames(node))
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    fname = call_name(dec)
+                    if fname in JIT_NAMES:
+                        note(node, _static_argnames(dec))
+                    elif (
+                        fname in ("functools.partial", "partial")
+                        and dec.args
+                        and dotted_name(dec.args[0]) in JIT_NAMES
+                    ):
+                        note(node, _static_argnames(dec))
+                elif dotted_name(dec) in JIT_NAMES:
+                    note(node, set())
+
+    for fn, statics in targets.values():
+        candidates = _param_names(fn) - statics - {"self"}
+        for sub in ast.walk(fn):
+            if (
+                isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and sub is not fn
+            ):
+                candidates -= _param_names(sub)  # shadowed: not the traced arg
+        if not candidates:
+            continue
+        seen: set[int] = set()
+        for node in walk_scope(fn, include_nested_defs=True):
+            if not isinstance(node, (ast.If, ast.While)) or node.lineno in seen:
+                continue
+            hits = sorted(_branch_names(node.test) & candidates)
+            if hits:
+                seen.add(node.lineno)
+                kind = "if" if isinstance(node, ast.If) else "while"
+                yield ctx.finding(
+                    node.lineno,
+                    "jit-static-branch",
+                    f"`{kind}` on parameter '{hits[0]}' of jitted "
+                    f"'{fn.name}' that is not in static_argnames — the "
+                    "branch is decided at trace time (bakes one side into "
+                    "the executable, or raises on a traced value); declare "
+                    "it static or use jnp.where/lax.cond on device values",
                 )
 
 

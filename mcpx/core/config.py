@@ -82,9 +82,34 @@ class EngineConfig:
     # sits behind a network tunnel: ~72ms measured vs ~7ms per async
     # dispatch). Retirement lags admission by depth-1 segments.
     pipeline_depth: int = 2
+    # Heterogeneous continuous batching: temperature, the constrained flag
+    # and the grammar become PER-ROW state (device vectors + stacked DFA
+    # tables indexed by a per-row dfa_id), so any pending request admits
+    # into any free row in strict queue order — no slab-wide compatibility
+    # gate, no drain-to-switch. Off (default) keeps the homogeneous slab:
+    # one (constrained, temperature, grammar) triple per slab, incompatible
+    # requests wait for a drain softened by fairness_timeout_s. Both modes'
+    # executables coexist, so the flag may be flipped on a LIVE engine: the
+    # slab latches its admission mode whenever it refills from empty, so a
+    # mid-occupancy flip simply pauses admission until the old-mode rows
+    # drain (rows admitted under one mode carry that mode's page-slack
+    # geometry and always decode under it).
+    hetero_batch: bool = False
+    # Stacked-DFA slots under hetero_batch: how many DISTINCT grammars can
+    # be resident in the slab at once (slot 0 is the trivial all-accept DFA
+    # for unconstrained rows, so hetero_grammar_slots-1 constrained
+    # grammars fit). The slot count is a STATIC shape — executables never
+    # recompile as grammars come and go; a request whose grammar finds no
+    # free slot waits for one (rare: the planner shares grammars per
+    # registry version).
+    hetero_grammar_slots: int = 4
     # Once the head of the pending line has waited this long behind an
     # incompatible slab (different grammar/temperature), stop admitting new
-    # rows so the slab drains and the head can run.
+    # rows so the slab drains and the head can run. Under hetero_batch the
+    # slab never drains to switch, but the same timeout bounds the one
+    # config-shaped wait left: a request whose grammar finds no free
+    # stacked slot stops admissions behind it once over-age, so resident
+    # rows retire and free a slot instead of later arrivals starving it.
     fairness_timeout_s: float = 0.5
     # Admission hysteresis: while the slab is busy, hold off prefilling a
     # new cohort until at least this many rows are free (0 = auto:
@@ -396,6 +421,11 @@ class MCPXConfig:
             problems.append("engine.max_batch_size must be >= 1")
         if self.engine.pipeline_depth < 1:
             problems.append("engine.pipeline_depth must be >= 1")
+        if self.engine.hetero_grammar_slots < 2:
+            problems.append(
+                "engine.hetero_grammar_slots must be >= 2 (slot 0 is the "
+                "trivial DFA; at least one constrained grammar must fit)"
+            )
         if self.engine.decode_steps_per_tick < 1:
             problems.append("engine.decode_steps_per_tick must be >= 1")
         if not 0.0 < self.telemetry.ewma_alpha <= 1.0:

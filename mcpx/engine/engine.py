@@ -23,6 +23,12 @@ replacement: an in-process serving stack where
   - within a segment, grammar masking, speculation fast-forward, sampling
     and KV writes all happen on-device with zero host round-trips per
     token; pools are donated so decode updates in place;
+  - with ``EngineConfig.hetero_batch`` the slab is **heterogeneous**:
+    temperature, the constrained flag and the grammar are per-row device
+    state (stacked DFA tables indexed by a per-row ``dfa_id``; per-row
+    greedy/stochastic selection in ``sample_rows``), so any request admits
+    into any free row in strict queue order — no slab-wide compatibility
+    triple, no drain-to-switch (docs/engine.md);
   - the engine is **multi-chip by default**: the mesh covers every visible
     device (TP over ``model`` for heads/MLP/vocab, DP over ``data`` for the
     slab rows), params restore sharded, and the paged KV pools carry a
@@ -60,12 +66,17 @@ from mcpx.core.config import MCPXConfig
 from mcpx.core.errors import EngineError
 from mcpx.engine.kv_cache import PageAllocator, commit_prefill_to_pages, init_paged_kv
 from mcpx.engine.paged_decode import decode_chunk_paged
-from mcpx.engine.sampling import sample
+from mcpx.engine.sampling import sample, sample_rows
 from mcpx.models.gemma.config import GemmaConfig
 from mcpx.models.gemma.model import init_kv_cache, prefill
 from mcpx.models.gemma.params import load_or_init
 from mcpx.models.tokenizer import make_tokenizer
-from mcpx.planner.grammar import PlanGrammar, build_plan_grammar
+from mcpx.planner.grammar import (
+    PlanGrammar,
+    build_plan_grammar,
+    build_trivial_grammar,
+    stacked_tables,
+)
 from mcpx.scheduler.admission import ewma_update
 from mcpx.telemetry.metrics import Metrics
 
@@ -180,10 +191,26 @@ class _Slab:
         self.queue_ms = np.zeros((B,), np.float64)
         self.prefill_ms = np.zeros((B,), np.float64)
         self.t_decode0 = np.zeros((B,), np.float64)
-        # Sampling config shared by every resident row (reset when empty).
+        # Per-row sampling config (heterogeneous batching): host mirrors of
+        # the device vectors the hetero segment reads — temperature, the
+        # constrained flag, and the stacked-DFA slot index (0 = trivial
+        # all-accept DFA for unconstrained rows). Scattered by the merges
+        # like every other row field; inert when hetero_batch is off.
+        self.temp = np.zeros((B,), np.float32)
+        self.cons = np.zeros((B,), bool)
+        self.dfa = np.zeros((B,), np.int32)
+        # Sampling config shared by every resident row (reset when empty) —
+        # the HOMOGENEOUS slab's compatibility triple (hetero_batch=off).
         self.constrained = True
         self.temperature = 0.0
         self.grammar: Optional[PlanGrammar] = None
+        # The batching mode the CURRENT occupancy was admitted under,
+        # latched whenever the slab refills from empty: rows admitted under
+        # one mode carry that mode's page-slack geometry, so a live
+        # EngineConfig.hetero_batch flip takes effect only at the next
+        # empty-slab admission — never mid-occupancy (admission pauses
+        # until the old-mode rows drain).
+        self.hetero = False
         # Device-resident copy of (cur, pos, st, emitted, done, budgets,
         # page_table, out_buf) between segments — None only at startup and
         # after a failure reset (host arrays are then authoritative). All
@@ -220,11 +247,26 @@ class _Slab:
         self.prompt_toks[i, :] = self.pad_id
         self.prompt_lens[i] = 0
         self.prev[i] = self.pad_id
+        self.temp[i] = 0.0
+        self.cons[i] = False
+        self.dfa[i] = 0
         self.gen[i] += 1
         self.page_table[i, :] = 0
         if self.prefix[i] is not None:
             self.prefix[i].refs -= 1
             self.prefix[i] = None
+
+
+# Legal lifecycle transitions: the single source of truth for the engine
+# state machine. ``_transition`` is the only mutator outside aclose(), which
+# forces the terminal "closed" from any state.
+_ENGINE_STATES: dict[str, tuple[str, ...]] = {
+    "cold": ("warming",),
+    "warming": ("ready", "failed", "closed"),
+    "ready": ("closed",),
+    "failed": ("closed",),
+    "closed": (),
+}
 
 
 class InferenceEngine:
@@ -246,6 +288,7 @@ class InferenceEngine:
         self.grammar: PlanGrammar = build_plan_grammar(self.tokenizer)
         self.metrics = metrics or Metrics()
         self.state = "cold"
+        self._state_lock = threading.Lock()
         self._mesh = mesh
         self._queue: "queue.Queue[Optional[GenerateRequest]]" = queue.Queue()
         self._thread: Optional[threading.Thread] = None
@@ -258,6 +301,23 @@ class InferenceEngine:
         self._seq_mesh = None
         self._dfa_cache: "OrderedDict[tuple, tuple]" = OrderedDict()
         self._prefix_cache: "OrderedDict[tuple, _Prefix]" = OrderedDict()
+        # Heterogeneous batching (EngineConfig.hetero_batch): the stacked-DFA
+        # slot table. ``_dfa_slots[k]`` is the grammar whose padded tables
+        # occupy stack index k (slot 0 = trivial all-accept DFA, None = free
+        # slot, filled with the trivial DFA when stacking); ``_dfa_slot_refs``
+        # counts resident rows per slot — a slot is reclaimable at refs == 0.
+        # ``_stack_cache`` holds the stacked device tables keyed by slot
+        # occupancy so re-admissions of resident grammars upload nothing.
+        # Worker thread only.
+        self._trivial_grammar: Optional[PlanGrammar] = None
+        self._dfa_slots: list[Optional[PlanGrammar]] = []
+        self._dfa_slot_refs: list[int] = []
+        self._stack_cache: Optional[tuple] = None  # (key, slot grammars, tables)
+        # Per-class backlog snapshot published by the worker each iteration
+        # for queue_stats() (cross-thread read of a freshly-swapped dict).
+        self._pending_stats: dict = {
+            "constrained": 0, "free": 0, "hol_wait_ms": 0.0,
+        }
         # Pipelined segment outputs awaiting their (lagged) flag fetch:
         # entries are (done, emitted, out_buf, n_fwd device handles,
         # gen snapshot); decode wall time is taken at harvest. Worker
@@ -336,19 +396,34 @@ class InferenceEngine:
         self._unconstrained_mask = jnp.asarray(um)
 
     # ------------------------------------------------------------- lifecycle
+    def _transition(self, to: str) -> bool:
+        """Move the lifecycle state machine to ``to`` iff legal from the
+        current state (``_ENGINE_STATES``); returns whether the transition
+        happened. The lock makes check-and-set atomic across the event loop
+        (start/aclose) and any coalescing start() callers — a close that
+        lands mid-start wins and stays won (the old bare writes could
+        resurrect a closed engine to "ready")."""
+        with self._state_lock:
+            if to in _ENGINE_STATES.get(self.state, ()):
+                self.state = to
+                return True
+            return False
+
     async def start(self) -> None:
         """Build mesh, load weights, compile, spin up the worker thread.
 
         Concurrent callers coalesce: whoever arrives while another start is
         in flight simply waits for it (the server launches startup as a
         background task so /healthz can report "warming"; the first real
-        requests then block here until the engine is ready)."""
+        requests then block here until the engine is ready). All state
+        writes go through the guarded ``_transition`` — exactly one caller
+        wins cold->warming (and starts the worker thread), and a concurrent
+        aclose() cannot be overwritten back to "ready"."""
         if self.state == "ready":
             return
         if self.state in ("closed", "failed"):
             raise EngineError(f"engine not startable (state={self.state})")
-        if self.state == "cold":
-            self.state = "warming"
+        if self._transition("warming"):
             self._thread = threading.Thread(
                 target=self._worker, daemon=True, name="mcpx-engine"
             )
@@ -356,13 +431,17 @@ class InferenceEngine:
         while not self._started.is_set():
             await asyncio.sleep(0.02)
         if self._startup_error is not None:
-            self.state = "failed"
+            self._transition("failed")
             raise EngineError(f"engine startup failed: {self._startup_error}")
-        if self.state == "warming":
-            self.state = "ready"
+        self._transition("ready")
+        if self.state != "ready":
+            # A concurrent aclose() closed the engine mid-start; the
+            # transition above lost, and this caller must not serve.
+            raise EngineError(f"engine not startable (state={self.state})")
 
     async def aclose(self) -> None:
-        self.state = "closed"
+        with self._state_lock:
+            self.state = "closed"  # terminal from ANY state, races included
         self._stop = True
         self._queue.put(None)
         if self._thread is not None:
@@ -380,6 +459,9 @@ class InferenceEngine:
             self._jit_suffix_prefill = None
             self._jit_merge = None
             self._jit_admit_merge = None
+            self._jit_hetero_admit = None
+            self._jit_hetero_segment = None
+            self._stack_cache = None
             self._inflight.clear()
             self._pending_admissions.clear()
             self._dfa_cache.clear()
@@ -442,11 +524,23 @@ class InferenceEngine:
         eta = math.ceil(overflow / B) * svc
         if active >= B:
             eta += svc
+        # Per-class backlog + head-of-line age over the WORKER's pending
+        # line (requests drained from the queue but not yet admitted — the
+        # population drain-to-switch used to starve), published by the
+        # worker each iteration; ``depth`` above counts the pre-drain queue.
+        ps = self._pending_stats
         return {
             "depth": depth,
             "active": active,
             "service_ewma_s": svc,
             "eta_s": eta,
+            "depth_constrained": ps["constrained"],
+            "depth_free": ps["free"],
+            "hol_wait_ms": ps["hol_wait_ms"],
+            "resident_grammars": sum(
+                1 for k in range(1, len(self._dfa_slot_refs))
+                if self._dfa_slot_refs[k] > 0
+            ),
         }
 
     # ------------------------------------------------------------ internals
@@ -573,6 +667,39 @@ class InferenceEngine:
         # readable.
         self._jit_merge = jax.jit(self._merge_impl)
         self._jit_admit_merge = jax.jit(self._admit_merge_impl)
+        # Heterogeneous batching executables: temperature/constrained are
+        # DEVICE VECTORS here, not static args, and the grammar arrives as a
+        # stacked [G, S, C] table set indexed by a per-row dfa_id — so ONE
+        # admit and ONE segment executable serve every sampling config and
+        # every resident-grammar combination (the executable count is
+        # independent of how many grammars are resident; acceptance
+        # criterion of the hetero refactor).
+        self._jit_hetero_admit = jax.jit(self._hetero_admit_impl)
+        self._jit_hetero_segment = jax.jit(
+            self._hetero_segment_impl,
+            static_argnames=("iters", "chunk"),
+            donate_argnames=("paged_k", "paged_v"),
+        )
+        if ecfg.hetero_batch and ecfg.draft_mode == "prompt":
+            # Not a validation error — both knobs default sensibly on their
+            # own — but the interaction must be loud: an operator flipping
+            # hetero_batch on keeps DFA fast-forward speculation yet loses
+            # prompt-lookup drafts, which can slow a single-config workload.
+            log.warning(
+                "hetero_batch=on disables draft_mode='prompt' speculation "
+                "(the heterogeneous segment is single-executable and its "
+                "proposal chain is single-grammar); grammar fast-forward "
+                "still applies per row — set draft_mode='off' to silence"
+            )
+        self._trivial_grammar = build_trivial_grammar(self.tokenizer)
+        # Slot 0 = trivial DFA (unconstrained rows); slot 1 pre-seeded with
+        # the engine's generic plan grammar so warmup's stack matches the
+        # common serving stack and default-grammar admissions never rebuild.
+        n_slots = max(2, ecfg.hetero_grammar_slots)
+        self._dfa_slots = [self._trivial_grammar, self.grammar] + [None] * (
+            n_slots - 2
+        )
+        self._dfa_slot_refs = [0] * n_slots
         capacity = ecfg.max_pages_per_seq * ecfg.kv_page_size
         fitting = [b for b in self._prefill_buckets if b <= capacity]
         self._slab = _Slab(
@@ -608,6 +735,60 @@ class InferenceEngine:
             self._dfa_cache.popitem(last=False)
         return tables
 
+    # --- heterogeneous batching: stacked-DFA slot management ---------------
+    def _stacked_dfa(self) -> tuple:
+        """Device copies of the resident grammars' tables stacked along a
+        leading slot axis ([G, S, C] / [G, S] / [G, C]) for per-row
+        ``dfa_id`` indexing inside the hetero segment. Free slots stack the
+        trivial DFA so G is a FIXED static shape — swapping a slot's
+        occupant re-uploads table DATA but never changes an executable.
+        Cached per slot occupancy (grammar identity per slot + pad geometry);
+        the cache holds the grammar objects so ids can't be recycled while
+        their tables are live. Worker thread only."""
+        pad = self._grammar_pad()
+        slots = [g if g is not None else self._trivial_grammar for g in self._dfa_slots]
+        key = (tuple(id(g) for g in slots), pad)
+        if self._stack_cache is not None and self._stack_cache[0] == key:
+            return self._stack_cache[2]
+        host = stacked_tables(slots, pad)
+        tables = tuple(jax.device_put(t, self._named(P())) for t in host)
+        self._stack_cache = (key, tuple(slots), tables)
+        return tables
+
+    def _grammar_slot_for(
+        self, grammar: PlanGrammar, reserved: set[int]
+    ) -> Optional[int]:
+        """Stacked-DFA slot for ``grammar``: the slot already holding it, a
+        free one, or a reclaimed refs==0 slot — None when every non-trivial
+        slot is held by a LIVE grammar (the caller defers the request until
+        a resident grammar drains; the only admission-order exception left
+        under hetero batching). ``reserved`` protects slots claimed earlier
+        in the same cohort (refs are bumped only at row assignment)."""
+        for k, g in enumerate(self._dfa_slots):
+            if g is grammar:
+                return k
+        for k in range(1, len(self._dfa_slots)):
+            if self._dfa_slots[k] is None and k not in reserved:
+                self._dfa_slots[k] = grammar
+                return k
+        for k in range(1, len(self._dfa_slots)):
+            if self._dfa_slot_refs[k] == 0 and k not in reserved:
+                self._dfa_slots[k] = grammar
+                return k
+        return None
+
+    def _drop_row_grammar(self, slab: "_Slab", i: int) -> None:
+        """Release row ``i``'s stacked-DFA slot reference (no-op for
+        unconstrained rows and when hetero batching never ran). The slot
+        keeps its grammar (tables stay warm for re-admission) until a new
+        grammar reclaims it at refs == 0."""
+        k = int(slab.dfa[i])
+        if 0 < k < len(self._dfa_slot_refs) and self._dfa_slot_refs[k] > 0:
+            self._dfa_slot_refs[k] -= 1
+        self.metrics.resident_grammars.set(
+            sum(1 for r in self._dfa_slot_refs[1:] if r > 0)
+        )
+
     def _warmup(self) -> None:
         """Execute one cohort per (A, T) bucket plus one decode segment so
         every HOT executable is compiled before the first real request
@@ -632,6 +813,11 @@ class InferenceEngine:
                 f"(kv_page_size*max_pages_per_seq); raise one of them"
             )
         dfa = self._dfa_for(self.grammar)
+        # Hetero mode warms the stacked executables instead of the legacy
+        # per-(temperature, constrained) ones: ONE admit + ONE segment
+        # compile covers every sampling config and grammar combination, so
+        # the compile count below is independent of what serving later mixes.
+        sdfa = self._stacked_dfa() if ecfg.hetero_batch else None
         key = jax.random.PRNGKey(0)
         for A in self._batch_buckets:
             last = None
@@ -668,19 +854,33 @@ class InferenceEngine:
                         self._paged_kv["v"],
                     )
                     self._paged_kv = {"k": k_p, "v": v_p}
-            admit_out = self._jit_admit(
-                *dfa,
-                last,
-                self._put(np.zeros((A,), np.int32), self._row_spec(A)),
-                self._put(np.zeros((A,), bool), self._row_spec(A)),
-                key,
-                temperature=ecfg.temperature,
-                constrained=True,
-            )
-            # Admit-merge executable for this cohort bucket (all-dropped
-            # scatter: rows filled with B = padding, a semantic no-op).
             rs_a = self._row_spec(A)
             rs_a2 = self._row_spec(A, 1)
+            budgets0 = self._put(np.zeros((A,), np.int32), rs_a)
+            active0 = self._put(np.zeros((A,), bool), rs_a)
+            if ecfg.hetero_batch:
+                admit_out = self._jit_hetero_admit(
+                    *sdfa,
+                    last,
+                    budgets0,
+                    active0,
+                    self._put(np.zeros((A,), np.float32), rs_a),
+                    self._put(np.ones((A,), bool), rs_a),
+                    self._put(np.ones((A,), np.int32), rs_a),
+                    key,
+                )
+            else:
+                admit_out = self._jit_admit(
+                    *dfa,
+                    last,
+                    budgets0,
+                    active0,
+                    key,
+                    temperature=ecfg.temperature,
+                    constrained=True,
+                )
+            # Admit-merge executable for this cohort bucket (all-dropped
+            # scatter: rows filled with B = padding, a semantic no-op).
             self._jit_admit_merge(
                 *self._dev_state(self._slab),
                 self._put(np.full((A,), self._slab.B, np.int32), rs_a),
@@ -696,31 +896,52 @@ class InferenceEngine:
                 ),
                 self._put(np.zeros((A,), np.int32), rs_a),
                 self._put(np.full((A,), tok.pad_id, np.int32), rs_a),
+                self._put(np.zeros((A,), np.float32), rs_a),
+                self._put(np.zeros((A,), bool), rs_a),
+                self._put(np.zeros((A,), np.int32), rs_a),
             )
         slab = self._slab
         chunk = self._spec_chunk(True)
         iters = max(1, ecfg.decode_steps_per_tick)
         rs_b = self._row_spec(slab.B)
         rs_b2 = self._row_spec(slab.B, 1)
-        out = self._jit_segment(
-            self._params,
-            *dfa,
-            *self._put_slab_state(slab),
-            self._paged_kv["k"],
-            self._paged_kv["v"],
-            *self._put_many(
-                (slab.out_buf, rs_b2),
-                (slab.prompt_toks, rs_b2),
-                (slab.prompt_lens, rs_b),
-                (slab.prev, rs_b),
-            ),
-            key,
-            iters=iters,
-            chunk=chunk,
-            temperature=ecfg.temperature,
-            constrained=True,
-            draft=ecfg.draft_mode == "prompt",
-        )
+        if ecfg.hetero_batch:
+            out = self._jit_hetero_segment(
+                self._params,
+                *sdfa,
+                *self._put_slab_state(slab),
+                self._paged_kv["k"],
+                self._paged_kv["v"],
+                self._put(slab.out_buf, rs_b2),
+                *self._put_many(
+                    (slab.temp, rs_b),
+                    (slab.cons, rs_b),
+                    (slab.dfa, rs_b),
+                ),
+                key,
+                iters=iters,
+                chunk=chunk,
+            )
+        else:
+            out = self._jit_segment(
+                self._params,
+                *dfa,
+                *self._put_slab_state(slab),
+                self._paged_kv["k"],
+                self._paged_kv["v"],
+                *self._put_many(
+                    (slab.out_buf, rs_b2),
+                    (slab.prompt_toks, rs_b2),
+                    (slab.prompt_lens, rs_b),
+                    (slab.prev, rs_b),
+                ),
+                key,
+                iters=iters,
+                chunk=chunk,
+                temperature=ecfg.temperature,
+                constrained=True,
+                draft=ecfg.draft_mode == "prompt",
+            )
         self._paged_kv = {"k": out[5], "v": out[6]}
         # Compile the admission/retirement merge scatter too (row 0 is free,
         # so merging its clear-values is a semantic no-op); the resulting
@@ -761,9 +982,11 @@ class InferenceEngine:
     def _dev_state(self, slab: "_Slab") -> tuple:
         """The device-resident slab state tuple — indices 0..7 are (cur,
         pos, st, emitted, done, budgets, page_table, out_buf); 8..10 the
-        draft-lookup state (prompt_toks, prompt_lens, prev). Initialised
-        from the host arrays (startup / after a failure reset) when
-        absent."""
+        draft-lookup state (prompt_toks, prompt_lens, prev); 11..13 the
+        per-row sampling config (temperature, constrained, dfa_id —
+        heterogeneous batching; scattered but unread when hetero_batch is
+        off). Initialised from the host arrays (startup / after a failure
+        reset) when absent."""
         if slab.dev is None:
             rs = self._row_spec(slab.B)
             rs2 = self._row_spec(slab.B, 1)
@@ -772,6 +995,9 @@ class InferenceEngine:
                 (slab.prompt_toks, rs2),
                 (slab.prompt_lens, rs),
                 (slab.prev, rs),
+                (slab.temp, rs),
+                (slab.cons, rs),
+                (slab.dfa, rs),
             )
         return slab.dev
 
@@ -788,6 +1014,9 @@ class InferenceEngine:
         ptoks,
         plens,
         prev,
+        temp,
+        cons,
+        dfa,
         rows,
         cur_v,
         pos_v,
@@ -800,6 +1029,9 @@ class InferenceEngine:
         ptoks_v,
         plens_v,
         prev_v,
+        temp_v,
+        cons_v,
+        dfa_v,
     ):
         """Scatter per-row values into the slab's device state: row
         ``rows[j]`` takes the j-th value of every value array. This is how
@@ -820,6 +1052,9 @@ class InferenceEngine:
             ptoks.at[rows].set(ptoks_v, mode="drop"),
             plens.at[rows].set(plens_v, mode="drop"),
             prev.at[rows].set(prev_v, mode="drop"),
+            temp.at[rows].set(temp_v, mode="drop"),
+            cons.at[rows].set(cons_v, mode="drop"),
+            dfa.at[rows].set(dfa_v, mode="drop"),
         )
 
     def _admit_merge_impl(
@@ -835,6 +1070,9 @@ class InferenceEngine:
         ptoks,
         plens,
         prev,
+        temp,
+        cons,
+        dfa,
         rows,
         cur0,
         st0,
@@ -845,6 +1083,9 @@ class InferenceEngine:
         ptoks_v,
         plens_v,
         prev_v,
+        temp_v,
+        cons_v,
+        dfa_v,
     ):
         """Scatter a freshly-prefilled admission cohort into the device slab
         state with ZERO host fetches: ``cur0``/``st0``/``done0`` are
@@ -875,6 +1116,9 @@ class InferenceEngine:
             ptoks.at[rows].set(ptoks_v, mode="drop"),
             plens.at[rows].set(plens_v, mode="drop"),
             prev.at[rows].set(prev_v, mode="drop"),
+            temp.at[rows].set(temp_v, mode="drop"),
+            cons.at[rows].set(cons_v, mode="drop"),
+            dfa.at[rows].set(dfa_v, mode="drop"),
         )
 
     def _poll_admissions(self, slab: "_Slab") -> None:
@@ -938,6 +1182,9 @@ class InferenceEngine:
                 (np.full((B, slab.prompt_cap), slab.pad_id, np.int32), rs2),
                 (np.zeros((B,), np.int32), rs),
                 (np.full((B,), slab.pad_id, np.int32), rs),
+                (np.zeros((B,), np.float32), rs),
+                (np.zeros((B,), bool), rs),
+                (np.zeros((B,), np.int32), rs),
             ),
         )
 
@@ -1076,6 +1323,72 @@ class InferenceEngine:
             ).astype(jnp.int32)
             done0 = (first == tok.eos_id) | ~active | (budgets < 1)
             state0 = start_state
+        cur0 = jnp.where(done0, tok.pad_id, first)
+        return cur0, state0, done0
+
+    def _stacked_budget_mask(self, sdfa, dfa_id, st, rem):
+        """Per-row variant of ``_budget_mask`` over STACKED grammar tables:
+        row b's mask comes from grammar slot ``dfa_id[b]`` of the [G, S, C]
+        stack. Same degrade-to-legal semantics; masks live in the stack's
+        common compact column space [B, C]."""
+        strans, smask, sdist, _sactive, seos = sdfa
+        legal = smask[dfa_id, st]  # [B, C]
+        succ = strans[dfa_id, st]  # [B, C]
+        finishable = legal & (
+            seos[dfa_id] | (sdist[dfa_id[:, None], succ] <= rem[:, None])
+        )
+        feasible = jnp.any(finishable, axis=-1, keepdims=True)
+        return jnp.where(feasible, finishable, legal)
+
+    def _hetero_admit_impl(
+        self,
+        sdfa_trans,
+        sdfa_mask,
+        sdfa_dist,
+        sdfa_active,
+        sdfa_eos,
+        first_logits,
+        budgets,
+        active,
+        temp_v,
+        cons_v,
+        dfa_id,
+        key,
+    ):
+        """Per-row first-sample for a heterogeneous admission cohort: every
+        row draws BOTH ways — budget-masked compact-column through its own
+        stacked grammar slot, and full-vocab unconstrained — and
+        ``jnp.where(cons_v, ...)`` keeps the one that applies; temperature
+        is a device vector (``sample_rows``). No static sampling args, so
+        one executable per cohort bucket serves every request mix."""
+        tok = self.tokenizer
+        sdfa = (sdfa_trans, sdfa_mask, sdfa_dist, sdfa_active, sdfa_eos)
+        A = budgets.shape[0]
+        start = jnp.zeros((A,), jnp.int32)
+        a_idx = jnp.arange(A)
+        act_rows = sdfa_active[dfa_id]  # [A, C]
+        mask0 = self._stacked_budget_mask(sdfa, dfa_id, start, budgets - 1)
+        col = sample_rows(
+            jnp.take_along_axis(first_logits, act_rows, axis=-1),
+            key,
+            temp_v,
+            top_k=self.config.engine.top_k,
+            mask=mask0,
+        ).astype(jnp.int32)
+        c_first = act_rows[a_idx, col]
+        u_first = sample_rows(
+            first_logits,
+            key,
+            temp_v,
+            top_k=self.config.engine.top_k,
+            mask=self._unconstrained_mask,
+        ).astype(jnp.int32)
+        first = jnp.where(cons_v, c_first, u_first)
+        ended = jnp.where(cons_v, sdfa_eos[dfa_id, col], u_first == tok.eos_id)
+        done0 = ended | ~active | (budgets < 1)
+        state0 = jnp.where(
+            done0 | ~cons_v, start, sdfa_trans[dfa_id, start, col]
+        )
         cur0 = jnp.where(done0, tok.pad_id, first)
         return cur0, state0, done0
 
@@ -1567,6 +1880,175 @@ class InferenceEngine:
         )
         return cur, pos, st, e, done, k_p, v_p, buf, prev, it
 
+    def _hetero_segment_impl(
+        self,
+        params,
+        sdfa_trans,
+        sdfa_mask,
+        sdfa_dist,
+        sdfa_active,
+        sdfa_eos,
+        cur,
+        pos,
+        st,
+        emitted,
+        done,
+        budgets,
+        page_table,
+        paged_k,
+        paged_v,
+        out_buf,
+        temp_v,
+        cons_v,
+        dfa_id,
+        key,
+        *,
+        iters: int,
+        chunk: int,
+    ):
+        """One bounded decode segment over a HETEROGENEOUS slab: each row
+        carries its own temperature (``temp_v``), constrained flag
+        (``cons_v``) and grammar (``dfa_id`` into the stacked [G, S, C]
+        tables), so a grammar-constrained greedy /plan, a free-form sampled
+        replan and a high-temperature exploration row all decode in the SAME
+        fused forward — the per-row principle Ragged Paged Attention applied
+        to the KV path, extended to sampling and grammar state. Per-row
+        mechanics:
+
+          - grammar fast-forward runs through the per-row tables; ``cons_v``
+            gates forcing, and the trivial slot-0 DFA has two legal columns
+            everywhere, so unconstrained rows never see a forced token;
+          - each forward samples BOTH ways — budget-masked compact-column
+            via the row's grammar slot, and full-vocab — then selects per
+            row; greedy rows take the same mask-then-argmax the homogeneous
+            path takes, so greedy outputs are token-identical to a
+            homogeneous run of the same request (tested);
+          - sampling statics are GONE: temperature/constrained are device
+            values and the grammar is data, so this one executable (per
+            iters/chunk config) serves every request mix — the compile
+            count is independent of resident grammars and sampling configs.
+
+        Prompt-lookup draft speculation is not offered here: its compact
+        unembed and proposal chain are single-grammar, and hetero mode
+        trades it for admission freedom (grammar fast-forward — the larger
+        win on plan JSON — stays). Returns (cur, pos, st, emitted, done,
+        pools_k, pools_v, out_buf, n_forwards)."""
+        cfg = self.model_cfg
+        tok = self.tokenizer
+        B = cur.shape[0]
+        W = out_buf.shape[1]
+        sdfa = (sdfa_trans, sdfa_mask, sdfa_dist, sdfa_active, sdfa_eos)
+        pad, eos = tok.pad_id, tok.eos_id
+        b_idx = jnp.arange(B)
+
+        def cond(c):
+            it, cur, pos, st, e, done, k_p, v_p, buf, key = c
+            return (it < iters) & jnp.any(~done)
+
+        def body(c):
+            it, cur, pos, st, e, done, k_p, v_p, buf, key = c
+
+            if chunk > 1:
+
+                def ff_step(carry, _):
+                    s, d, er = carry
+                    row = sdfa_mask[dfa_id, s]  # [B, C]
+                    t_c = jnp.argmax(row, axis=-1).astype(jnp.int32)
+                    forced = cons_v & (jnp.sum(row, axis=-1) == 1) & ~d
+                    is_eos = forced & sdfa_eos[dfa_id, t_c]
+                    emit = forced & ~is_eos & (er < budgets)
+                    over = forced & ~is_eos & (er >= budgets)
+                    return (
+                        jnp.where(emit, sdfa_trans[dfa_id, s, t_c], s),
+                        d | is_eos | over,
+                        er + emit,
+                    ), (jnp.where(emit, sdfa_active[dfa_id, t_c], pad), emit)
+
+                (st1, done1, e1), (ff_toks, ff_emit) = lax.scan(
+                    ff_step, (st, done, e), None, length=chunk - 1
+                )
+                ff_toks = ff_toks.T  # [B, chunk-1]
+                ff_emit = ff_emit.T
+                idx = jnp.where(
+                    ff_emit, e[:, None] + jnp.cumsum(ff_emit, axis=1) - 1, W
+                )
+                buf = buf.at[b_idx[:, None], idx].set(ff_toks, mode="drop")
+                chunk_toks = jnp.concatenate([cur[:, None], ff_toks], axis=1)
+                adv_extra = jnp.sum(ff_emit, axis=1)
+            else:
+                st1, done1, e1 = st, done, e
+                chunk_toks = cur[:, None]
+                adv_extra = 0
+
+            adv = jnp.where(done, 0, 1) + adv_extra
+            last_logits, kv = decode_chunk_paged(
+                params,
+                cfg,
+                chunk_toks,
+                pos,
+                page_table,
+                {"k": k_p, "v": v_p},
+                use_pallas=self._use_pallas,
+                interpret=self.config.engine.interpret,
+                logits_at=jnp.maximum(adv - 1, 0),  # [B, V]: chain-end only
+            )
+
+            key, sub = jax.random.split(key)
+            act_rows = sdfa_active[dfa_id]  # [B, C]
+            mask = self._stacked_budget_mask(sdfa, dfa_id, st1, budgets - e1 - 1)
+            col = sample_rows(
+                jnp.take_along_axis(last_logits, act_rows, axis=-1),
+                sub,
+                temp_v,
+                top_k=self.config.engine.top_k,
+                mask=mask,
+            ).astype(jnp.int32)
+            c_tok = act_rows[b_idx, col]
+            u_tok = sample_rows(
+                last_logits,
+                sub,
+                temp_v,
+                top_k=self.config.engine.top_k,
+                mask=self._unconstrained_mask,
+            ).astype(jnp.int32)
+            nxt_id = jnp.where(cons_v, c_tok, u_tok)
+            ended = jnp.where(cons_v, sdfa_eos[dfa_id, col], u_tok == eos)
+            newly_done = done1 | ended | (e1 >= budgets)
+            st_next = jnp.where(
+                newly_done | ~cons_v, st1, sdfa_trans[dfa_id, st1, col]
+            )
+            nxt = jnp.where(newly_done, pad, nxt_id)
+            buf = buf.at[b_idx, jnp.where(newly_done, W, e1)].set(nxt, mode="drop")
+            return (
+                it + 1,
+                nxt,
+                pos + adv,
+                st_next,
+                e1 + jnp.where(newly_done, 0, 1),
+                newly_done,
+                kv["k"],
+                kv["v"],
+                buf,
+                key,
+            )
+
+        init = (
+            jnp.asarray(0, jnp.int32),
+            cur,
+            pos,
+            st,
+            emitted,
+            done,
+            paged_k,
+            paged_v,
+            out_buf,
+            key,
+        )
+        it, cur, pos, st, e, done, k_p, v_p, buf, key = lax.while_loop(
+            cond, body, init
+        )
+        return cur, pos, st, e, done, k_p, v_p, buf, it
+
     # --- worker -----------------------------------------------------------
     def _worker(self) -> None:
         try:
@@ -1585,6 +2067,7 @@ class InferenceEngine:
             )
             if self._stop:
                 break
+            self._refresh_queue_gauges(pending)
             self._poll_admissions(slab)
             self._reap_cancelled(slab)
             if pending and slab.n_active < slab.B:
@@ -1637,6 +2120,25 @@ class InferenceEngine:
             if r is not None:
                 r.loop.call_soon_threadsafe(_resolve, r.future, None, closed)
 
+    def _refresh_queue_gauges(self, pending: "deque[GenerateRequest]") -> None:
+        """Publish the per-class backlog and head-of-line age of the
+        worker's pending line: a fresh dict swapped in whole (GIL-atomic)
+        for queue_stats(), plus the /metrics gauges. Worker thread only;
+        approximate by design — the numbers describe the instant between
+        two segments."""
+        n_cons = sum(1 for r in pending if r.constrained)
+        n_free = len(pending) - n_cons
+        head_ms = (
+            (time.monotonic() - pending[0].enqueued_at) * 1e3 if pending else 0.0
+        )
+        self._pending_stats = {
+            "constrained": n_cons,
+            "free": n_free,
+            "hol_wait_ms": head_ms,
+        }
+        self.metrics.queue_depth_class.labels(cls="constrained").set(n_cons)
+        self.metrics.queue_depth_class.labels(cls="free").set(n_free)
+
     def _drain_queue(self, pending: "deque[GenerateRequest]", block: bool) -> None:
         """Move queued requests into ``pending``. When idle (``block``), wait
         briefly for the first arrival, then hold a short gather window so a
@@ -1672,31 +2174,54 @@ class InferenceEngine:
                 pending.append(item)
 
     def _admit(self, slab: "_Slab", pending: "deque[GenerateRequest]") -> None:
-        """Admit compatible pending requests into free slab rows: prefill the
-        cohort, commit its KV to pages, first-sample, merge row state.
+        """Admit pending requests into free slab rows: prefill the cohort,
+        commit its KV to pages, first-sample, merge row state.
 
-        Compatibility (constrained flag, temperature, grammar object) is
-        slab-wide — all resident rows share one fused decode segment. When
-        the slab is empty its config resets to the head request's. A pending
-        request incompatible with a busy slab waits for it to drain;
-        ``fairness_timeout_s`` stops further admissions once the head of the
-        line has waited that long, so a steady compatible stream cannot
-        starve it forever."""
+        Homogeneous mode (``hetero_batch=off``): compatibility (constrained
+        flag, temperature, grammar object) is slab-wide — all resident rows
+        share one fused decode segment. When the slab is empty its config
+        resets to the head request's. A pending request incompatible with a
+        busy slab waits for it to drain; ``fairness_timeout_s`` stops
+        further admissions once the head of the line has waited that long,
+        so a steady compatible stream cannot starve it forever.
+
+        Heterogeneous mode (``hetero_batch=on``): sampling config and
+        grammar are per-row state, so ANY pending request fits ANY free row
+        and admission is strictly queue-ordered — no compatibility gate, no
+        drain-to-switch. The small-cohort hysteresis (prefill amortisation)
+        still applies; the only ordering exceptions left are page pressure,
+        a full stacked-grammar slot table (where ``fairness_timeout_s``
+        bounds the wait: an over-age slot-starved request stops admissions
+        behind it until a slot drains), and differing shared-prefix keys
+        (which only shape cohorts, not rows)."""
         ecfg = self.config.engine
         tok = self.tokenizer
         free = slab.free_rows()
         if not free or not pending:
             return
         if slab.n_active == 0:
+            slab.hetero = ecfg.hetero_batch  # mode latch: see _Slab.hetero
+        elif slab.hetero != ecfg.hetero_batch:
+            # The flag flipped while rows admitted under the OLD mode are
+            # still decoding: their page-slack geometry belongs to that
+            # mode, so pause admission and let them drain — the flip lands
+            # at the next empty-slab admission. This is what makes a
+            # runtime flip (bench mixed phase, operator rollback) safe
+            # rather than merely documented-safe.
+            return
+        hetero = slab.hetero
+        if not hetero and slab.n_active == 0:
             head = pending[0]
             slab.constrained = head.constrained
             slab.temperature = head.temperature
             slab.grammar = head.grammar
-        elif not slab.compatible(pending[0]) and (
+        elif not hetero and not slab.compatible(pending[0]) and (
             time.monotonic() - pending[0].enqueued_at > ecfg.fairness_timeout_s
         ):
             return  # drain the slab so the head of the line can run
-        elif len(free) < (ecfg.admit_min_free or max(1, slab.B // 4)) and (
+        elif slab.n_active and len(free) < (
+            ecfg.admit_min_free or max(1, slab.B // 4)
+        ) and (
             time.monotonic() - self._last_admit_t < ecfg.admit_max_wait_s
         ):
             # Busy slab, few free rows, admitted recently: keep decoding and
@@ -1710,7 +2235,10 @@ class InferenceEngine:
 
     # --- shared-prefix resolution (the cohort shares one prefix key; the
     # planner's fixed prompt header makes this the common case)
-        head_req = next((r for r in pending if slab.compatible(r)), None)
+        if hetero:
+            head_req = next((r for r in pending if not r.future.cancelled()), None)
+        else:
+            head_req = next((r for r in pending if slab.compatible(r)), None)
         if head_req is None:
             return
         # Retired rows' DEVICE page tables must be zeroed BEFORE any pages
@@ -1754,11 +2282,15 @@ class InferenceEngine:
     ) -> None:
         ecfg = self.config.engine
         tok = self.tokenizer
+        hetero = slab.hetero  # the latched admission mode, not the live flag
         free = slab.free_rows()
         P = prefix.n_tokens if prefix is not None else 0
 
     # --- per-request geometry
-        spec_chunk = self._spec_chunk(slab.constrained)
+        # Hetero slabs always run the constrained-width chunk (the segment
+        # is one executable for every mix; unconstrained rows just never
+        # force), so every row's pages carry the chunk's garbage-write slack.
+        spec_chunk = self._spec_chunk(True if hetero else slab.constrained)
         slack = spec_chunk if spec_chunk > 1 else 0
         capacity = ecfg.max_pages_per_seq * ecfg.kv_page_size
         budget_cap = min(slab.steps, capacity - 1 - slack - P)
@@ -1782,6 +2314,8 @@ class InferenceEngine:
         cohort: list[GenerateRequest] = []
         prompts: list[list[int]] = []  # SUFFIX ids (whole prompt when P == 0)
         budgets: list[int] = []
+        slots: list[int] = []  # stacked-DFA slot per cohort member (hetero)
+        reserved: set[int] = set()
         defer: list[GenerateRequest] = []
         while pending and len(cohort) < len(free):
             r = pending.popleft()
@@ -1790,14 +2324,41 @@ class InferenceEngine:
                 # skipping here saves the prefill compute and pages that
                 # _reap_cancelled would otherwise claw back a tick later.
                 continue
-            if not slab.compatible(r) or (
-                head_key is not None and r.prefix_key(ecfg.kv_page_size) != head_key
-            ):
-                # Different sampling config or different shared prefix: wait
-                # for a later cohort (prefix only shapes ADMISSION; rows
-                # with different prefixes decode side by side just fine).
+            if head_key is not None and r.prefix_key(ecfg.kv_page_size) != head_key:
+                # Different shared prefix: wait for a later cohort (prefix
+                # only shapes ADMISSION; rows with different prefixes decode
+                # side by side just fine).
                 defer.append(r)
                 continue
+            if hetero:
+                slot = 0
+                if r.constrained:
+                    slot = self._grammar_slot_for(r.grammar or self.grammar, reserved)
+                    if slot is None:
+                        # Every stacked slot holds a LIVE grammar: this
+                        # request waits for one to drain — the only
+                        # config-shaped admission wait left under hetero.
+                        # fairness_timeout_s still bounds it: once this
+                        # request has waited that long, nothing behind it
+                        # admits either, so resident rows retire (decode is
+                        # budget-bounded), a slot's refcount hits zero, and
+                        # the next admission serves it — a later-arriving
+                        # stream on the resident grammars cannot starve it.
+                        defer.append(r)
+                        if (
+                            time.monotonic() - r.enqueued_at
+                            > ecfg.fairness_timeout_s
+                        ):
+                            break
+                        continue
+                    reserved.add(slot)
+            elif not slab.compatible(r):
+                # Homogeneous slab: different sampling config waits for a
+                # drain (the drain-to-switch path hetero_batch deletes).
+                defer.append(r)
+                continue
+            else:
+                slot = 0
             budget = max(1, min(r.max_new_tokens, budget_cap))
             # Keep the prompt HEAD on overflow — the planner ranks its best
             # candidate services first and trims the tail, and the engine
@@ -1813,6 +2374,7 @@ class InferenceEngine:
             cohort.append(r)
             prompts.append(ids)
             budgets.append(budget)
+            slots.append(slot)
         for r in reversed(defer):
             pending.appendleft(r)
         if not cohort:
@@ -1825,6 +2387,12 @@ class InferenceEngine:
         seq_lens = np.ones((A,), np.int32)
         active = np.zeros((A,), bool)
         budgets_np = np.zeros((A,), np.int32)
+        # Per-row sampling config scattered at merge: the head request's
+        # slab-wide config in homogeneous mode, each request's own in
+        # hetero mode (padding lanes stay at the inert defaults).
+        temp_np = np.zeros((A,), np.float32)
+        cons_np = np.zeros((A,), bool)
+        dfa_np = np.zeros((A,), np.int32)
         table = np.zeros((A, ecfg.max_pages_per_seq), np.int32)
         sids: list[tuple] = []
         for j, (r, ids, budget) in enumerate(zip(cohort, prompts, budgets)):
@@ -1833,6 +2401,13 @@ class InferenceEngine:
             seq_lens[j] = len(ids)
             active[j] = True
             budgets_np[j] = budget
+            if hetero:
+                temp_np[j] = r.temperature
+                cons_np[j] = r.constrained
+                dfa_np[j] = slots[j]
+            else:
+                temp_np[j] = slab.temperature
+                cons_np[j] = slab.constrained
             self._seq_counter += 1
             sid = ("seq", self._seq_counter)
             pages = self._allocator.allocate(sid, len(ids) + budget + slack)
@@ -1844,18 +2419,26 @@ class InferenceEngine:
 
         try:
             t0 = time.monotonic()
-            dfa = self._dfa_for(slab.grammar or self.grammar)
+            dfa = None if hetero else self._dfa_for(slab.grammar or self.grammar)
+            sdfa = self._stacked_dfa() if hetero else None
             # All of this admission's row arrays go up in ONE dispatch
-            # (budgets/active ride along for the _jit_admit call below).
+            # (budgets/active/sampling-config ride along for the admit call
+            # and the admit-merge below).
             rs, rs2 = self._row_spec(A), self._row_spec(A, 1)
             if prefix is not None:
-                tokens_d, lens_d, p_d, table_d, budgets_d, active_d = self._put_many(
+                (
+                    tokens_d, lens_d, p_d, table_d, budgets_d, active_d,
+                    temp_d, cons_d, dfa_d,
+                ) = self._put_many(
                     (tokens, rs2),
                     (seq_lens, rs),
                     (np.full((A,), P, np.int32), rs),
                     (table, rs2),
                     (budgets_np, rs),
                     (active, rs),
+                    (temp_np, rs),
+                    (cons_np, rs),
+                    (dfa_np, rs),
                 )
                 # Suffix-only prefill: one chunked forward whose queries
                 # start at position P and attend the shared prefix pages +
@@ -1871,12 +2454,18 @@ class InferenceEngine:
                     self._paged_kv["v"],
                 )
             else:
-                tokens_d, lens_d, table_d, budgets_d, active_d = self._put_many(
+                (
+                    tokens_d, lens_d, table_d, budgets_d, active_d,
+                    temp_d, cons_d, dfa_d,
+                ) = self._put_many(
                     (tokens, rs2),
                     (seq_lens, rs),
                     (table, rs2),
                     (budgets_np, rs),
                     (active, rs),
+                    (temp_np, rs),
+                    (cons_np, rs),
+                    (dfa_np, rs),
                 )
                 use_ring = self._ring_ok(T)
                 if use_ring:
@@ -1899,15 +2488,30 @@ class InferenceEngine:
             # for prefill/first-sample. (The old blocking fetch here cost a
             # full device-queue drain + round trip per cohort, the largest
             # single stall in the serving loop once segments pipelined.)
-            cur0, st0, done0 = self._jit_admit(
-                *dfa,
-                last_logits,
-                budgets_d,
-                active_d,
-                jax.random.PRNGKey((self._rng_base + self._seg_counter) & 0x7FFFFFFF),
-                temperature=slab.temperature,
-                constrained=slab.constrained,
+            prng = jax.random.PRNGKey(
+                (self._rng_base + self._seg_counter) & 0x7FFFFFFF
             )
+            if hetero:
+                cur0, st0, done0 = self._jit_hetero_admit(
+                    *sdfa,
+                    last_logits,
+                    budgets_d,
+                    active_d,
+                    temp_d,
+                    cons_d,
+                    dfa_d,
+                    prng,
+                )
+            else:
+                cur0, st0, done0 = self._jit_admit(
+                    *dfa,
+                    last_logits,
+                    budgets_d,
+                    active_d,
+                    prng,
+                    temperature=slab.temperature,
+                    constrained=slab.constrained,
+                )
         except BaseException as e:  # mcpx: ignore[broad-except] - fail cohort AND residents; e propagates to their futures
             # Prefill DONATES the pools: after a dispatch failure the
             # resident rows' KV may live in already-deleted buffers, so they
@@ -1947,12 +2551,22 @@ class InferenceEngine:
             slab.done[i] = False
             slab.budgets[i] = budgets_np[j]
             slab.page_table[i, :] = table[j]
+            slab.temp[i] = temp_np[j]
+            slab.cons[i] = cons_np[j]
+            slab.dfa[i] = dfa_np[j]
+            if hetero and dfa_np[j] > 0:
+                self._dfa_slot_refs[int(dfa_np[j])] += 1
             slab.queue_ms[i] = (t0 - r.enqueued_at) * 1e3
+            self.metrics.hol_wait.observe(slab.queue_ms[i])
             slab.prefill_ms[i] = -1.0  # resolved by _poll_admissions
             slab.t_decode0[i] = t1
             if prefix is not None:
                 prefix.refs += 1
                 slab.prefix[i] = prefix
+        if hetero:
+            self.metrics.resident_grammars.set(
+                sum(1 for n in self._dfa_slot_refs[1:] if n > 0)
+            )
         rows_arr = np.full((A,), slab.B, np.int32)  # B = dropped padding
         rows_arr[: len(rows_idx)] = rows_idx
         pos_arr = np.zeros((A,), np.int32)
@@ -1989,6 +2603,9 @@ class InferenceEngine:
                 ptoks_d,
                 lens_d,  # still live: prefill donates only the pools
                 prev_d,
+                temp_d,  # still live, same reason
+                cons_d,
+                dfa_d,
             )
         except BaseException as e:  # mcpx: ignore[broad-except] - rows already assigned; e propagates to every resident request future
             self._fail_rows(slab, e)
@@ -2006,6 +2623,7 @@ class InferenceEngine:
         refreshed) shared by retirement, reaping and failure cleanup — the
         release invariant must not drift between those paths."""
         self._allocator.free(slab.sid[i])
+        self._drop_row_grammar(slab, i)
         slab.clear_row(i)
         self._dirty_rows.add(i)
         self.metrics.kv_page_utilization.set(self._allocator.stats().utilization)
@@ -2030,46 +2648,76 @@ class InferenceEngine:
     def _dispatch_segment(self, slab: "_Slab") -> None:
         """Dispatch one decode segment chained on the device slab state and
         push its output handles onto the in-flight deque. Async: returns as
-        soon as XLA has the work enqueued (~ms), while the device computes."""
+        soon as XLA has the work enqueued (~ms), while the device computes.
+        Hetero mode dispatches the stacked-table per-row executable (one
+        compile for every sampling/grammar mix); homogeneous mode keeps the
+        per-(temperature, constrained) specialised segment. The mode is the
+        slab's LATCHED admission mode, not the live config flag — resident
+        rows always decode under the geometry they were admitted with."""
         ecfg = self.config.engine
-        chunk = self._spec_chunk(slab.constrained)
+        hetero = slab.hetero
+        chunk = self._spec_chunk(True if hetero else slab.constrained)
         iters = max(1, ecfg.decode_steps_per_tick)
         self.metrics.segments.inc()
         self.metrics.segment_active_rows.inc(slab.n_active)
-        dfa = self._dfa_for(slab.grammar or self.grammar)
         self._seg_counter += 1
         (
             cur_d, pos_d, st_d, e_d, done_d, budgets_d, pt_d, buf_in,
-            ptoks_d, plens_d, prev_d,
+            ptoks_d, plens_d, prev_d, temp_d, cons_d, dfa_d,
         ) = self._dev_state(slab)
-        out = self._jit_segment(
-            self._params,
-            *dfa,
-            cur_d,
-            pos_d,
-            st_d,
-            e_d,
-            done_d,
-            budgets_d,
-            pt_d,
-            self._paged_kv["k"],
-            self._paged_kv["v"],
-            buf_in,
-            ptoks_d,
-            plens_d,
-            prev_d,
-            jax.random.PRNGKey((self._rng_base + self._seg_counter) & 0x7FFFFFFF),
-            iters=iters,
-            chunk=chunk,
-            temperature=slab.temperature,
-            constrained=slab.constrained,
-            draft=ecfg.draft_mode == "prompt",
-        )
-        cur_d, pos_d, st_d, e_d, done_d, k_p, v_p, buf_d, prev_d, n_fwd = out
+        prng = jax.random.PRNGKey((self._rng_base + self._seg_counter) & 0x7FFFFFFF)
+        if hetero:
+            out = self._jit_hetero_segment(
+                self._params,
+                *self._stacked_dfa(),
+                cur_d,
+                pos_d,
+                st_d,
+                e_d,
+                done_d,
+                budgets_d,
+                pt_d,
+                self._paged_kv["k"],
+                self._paged_kv["v"],
+                buf_in,
+                temp_d,
+                cons_d,
+                dfa_d,
+                prng,
+                iters=iters,
+                chunk=chunk,
+            )
+            cur_d, pos_d, st_d, e_d, done_d, k_p, v_p, buf_d, n_fwd = out
+        else:
+            dfa = self._dfa_for(slab.grammar or self.grammar)
+            out = self._jit_segment(
+                self._params,
+                *dfa,
+                cur_d,
+                pos_d,
+                st_d,
+                e_d,
+                done_d,
+                budgets_d,
+                pt_d,
+                self._paged_kv["k"],
+                self._paged_kv["v"],
+                buf_in,
+                ptoks_d,
+                plens_d,
+                prev_d,
+                prng,
+                iters=iters,
+                chunk=chunk,
+                temperature=slab.temperature,
+                constrained=slab.constrained,
+                draft=ecfg.draft_mode == "prompt",
+            )
+            cur_d, pos_d, st_d, e_d, done_d, k_p, v_p, buf_d, prev_d, n_fwd = out
         self._paged_kv = {"k": k_p, "v": v_p}
         slab.dev = (
             cur_d, pos_d, st_d, e_d, done_d, budgets_d, pt_d, buf_d,
-            ptoks_d, plens_d, prev_d,
+            ptoks_d, plens_d, prev_d, temp_d, cons_d, dfa_d,
         )
         self._inflight.append((done_d, e_d, buf_d, n_fwd, slab.gen.copy()))
 
@@ -2181,6 +2829,7 @@ class InferenceEngine:
                 continue
             if slab.sid[i] is not None:
                 self._allocator.free(slab.sid[i])
+            self._drop_row_grammar(slab, i)
             slab.clear_row(i)
             r.loop.call_soon_threadsafe(_resolve, r.future, None, error)
         self.metrics.kv_page_utilization.set(self._allocator.stats().utilization)

@@ -41,3 +41,31 @@ def sample(
         kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, NEG_INF, logits)
     return jax.random.categorical(key, logits, axis=-1)
+
+
+def sample_rows(
+    logits: jax.Array,
+    key: jax.Array,
+    temperature: jax.Array,
+    *,
+    top_k: int = 0,
+    mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Sample token ids from [B, V] logits with a PER-ROW temperature vector
+    ([B] float): rows with ``temperature <= 0`` decode greedily, the rest
+    sample at their own temperature — one traced body, no per-config
+    executables (the heterogeneous engine's per-row sampling primitive).
+    Both branches are computed and selected with ``jnp.where``; greedy rows'
+    argmax is bit-identical to :func:`sample` at ``temperature=0`` (same
+    mask-then-argmax order), so homogeneous and heterogeneous greedy decode
+    agree token-for-token."""
+    logits = logits.astype(jnp.float32)
+    if mask is not None:
+        logits = jnp.where(mask, logits, NEG_INF)
+    greedy = jnp.argmax(logits, axis=-1)
+    scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+    if top_k > 0 and top_k < logits.shape[-1]:
+        kth = jax.lax.top_k(scaled, top_k)[0][..., -1:]
+        scaled = jnp.where(scaled < kth, NEG_INF, scaled)
+    stochastic = jax.random.categorical(key, scaled, axis=-1)
+    return jnp.where(temperature <= 0.0, greedy, stochastic)

@@ -279,6 +279,81 @@ class PlanGrammar:
         return s
 
 
+def build_trivial_grammar(tokenizer=None) -> PlanGrammar:
+    """The all-accept DFA occupying stacked-DFA slot 0 in the heterogeneous
+    engine: every UNCONSTRAINED slab row carries ``dfa_id == 0`` so the
+    fused per-row table gathers stay in range. Its compact tables are shaped
+    like any grammar's but deliberately inert:
+
+      - two legal columns in the live state, so grammar fast-forward (which
+        forces a token only when exactly ONE column is legal) never forces
+        anything for unconstrained rows;
+      - self-looping transitions, so a row's state stays pinned at 0;
+      - the sampled column is never consulted — unconstrained rows sample
+        the full vocabulary and ``jnp.where(cons, ...)`` discards the
+        compact-space draw.
+
+    ``walk``/``is_accept`` accept every byte string (state 0 is accepting),
+    matching the "no constraint" contract for host-side checks."""
+    tok = tokenizer or ByteTokenizer()
+    ctrans = np.asarray([[0, 0], [1, 1]], np.int32)  # state 1 = dead
+    cmask = np.asarray([[True, True], [False, False]], bool)
+    dist = np.asarray([1, _DIST_INF], np.int32)
+    byte_trans = np.zeros((2, 256), np.int32)
+    byte_trans[1, :] = 1
+    return PlanGrammar(
+        ctrans=ctrans,
+        cmask=cmask,
+        dist=dist,
+        active_ids=np.asarray([tok.eos_id, tok.bos_id], np.int32),
+        eos_cols=np.asarray([True, False], bool),
+        cdead=1,
+        start_state=0,
+        byte_transitions=byte_trans,
+        dead_state=1,
+        accept_states=frozenset({0}),
+        tokenizer=tok,
+    )
+
+
+def stacked_tables(
+    grammars: "list[PlanGrammar]", pad_multiple: int = 512
+) -> tuple[np.ndarray, ...]:
+    """Stack several grammars' compact tables along a new leading axis so a
+    per-row ``dfa_id`` can index them inside one fused decode segment
+    (heterogeneous batching). Every grammar pads to the COMMON shape — the
+    max state pad bucket and the max column bucket over the stack — with the
+    same inert padding semantics as ``device_tables`` (mask False,
+    transitions to that grammar's dead state, active id PAD, dist inf).
+    Returns host arrays ``(trans [G,S,C], mask [G,S,C], dist [G,S],
+    active_ids [G,C], eos_cols [G,C])``; the stack's shape depends only on
+    the pad buckets, never on G's occupants, so swapping one resident
+    grammar for another re-uploads data without changing any executable."""
+    if not grammars:
+        raise ValueError("stacked_tables needs at least one grammar")
+    S = max(
+        ((g.n_states + pad_multiple - 1) // pad_multiple) * pad_multiple
+        for g in grammars
+    )
+    C = max(_col_bucket(g.n_active) for g in grammars)
+    G = len(grammars)
+    pad_id = grammars[0].tokenizer.pad_id
+    trans = np.empty((G, S, C), np.int32)
+    mask = np.zeros((G, S, C), bool)
+    dist = np.full((G, S), _DIST_INF, np.int32)
+    ids = np.full((G, C), pad_id, np.int32)
+    eos = np.zeros((G, C), bool)
+    for gi, g in enumerate(grammars):
+        n, c = g.ctrans.shape
+        trans[gi, :, :] = g.cdead
+        trans[gi, :n, :c] = g.ctrans
+        mask[gi, :n, :c] = g.cmask
+        dist[gi, :n] = g.dist
+        ids[gi, :c] = g.active_ids
+        eos[gi, :c] = g.eos_cols
+    return trans, mask, dist, ids, eos
+
+
 def _validate_trie_names(names, what: str) -> list[bytes]:
     seen = set()
     out: list[bytes] = []

@@ -248,6 +248,17 @@ def build_app(cp: ControlPlane) -> web.Application:
         engine = getattr(cp.planner, "engine", None)
         engine_state = getattr(engine, "state", "n/a") if engine is not None else "n/a"
         body: dict[str, Any] = {"status": "ok", "engine": engine_state}
+        if engine_state == "ready":
+            # Engine load snapshot (the scheduler's queue_stats() feed):
+            # occupancy, per-class backlog, head-of-line age and resident
+            # grammar count — a remote operator's one-call view of whether
+            # the slab is starving a traffic class, without Prometheus.
+            # float()/int() also strip numpy scalar types (service_ewma_s is
+            # an np.float64), which json.dumps would reject.
+            body["engine_queue"] = {
+                k: (round(float(v), 3) if isinstance(v, float) else int(v))
+                for k, v in engine.queue_stats().items()
+            }
         # Surface the startup failure cause: a remote operator (or the bench
         # session log) must be able to see WHY the engine is down without
         # shell access to the server's stderr — e.g. a device OOM string.

@@ -134,6 +134,32 @@ class Metrics:
             "Shared-prefix KV entries built",
             registry=self.registry,
         )
+        self.resident_grammars = Gauge(
+            "mcpx_engine_resident_grammars",
+            "Distinct constrained grammars resident in the decode slab "
+            "(heterogeneous batching stacks their DFA tables; the trivial "
+            "all-accept DFA for unconstrained rows is not counted)",
+            registry=self.registry,
+        )
+        # Milliseconds, matching what it measures: drain-to-switch waits are
+        # tens-to-hundreds of ms, far off the request-latency bucket grid.
+        self.hol_wait = Histogram(
+            "mcpx_engine_hol_wait_ms",
+            "Head-of-line wait: enqueue to admission-prefill start, per "
+            "admitted request (milliseconds). Under a mixed stream this is "
+            "where homogeneous-slab drain-to-switch shows up; heterogeneous "
+            "batching admits in queue order and flattens it",
+            buckets=(1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000, 10000),
+            registry=self.registry,
+        )
+        self.queue_depth_class = Gauge(
+            "mcpx_engine_queue_depth_class",
+            "Unadmitted engine requests by class (constrained vs free-form) "
+            "— a homogeneous slab starves one class while serving the other; "
+            "per-class depth makes that visible",
+            ["cls"],
+            registry=self.registry,
+        )
         self.prefill_tokens = Counter(
             "mcpx_engine_prefill_tokens_total",
             "Real (unpadded) prompt tokens prefilled — with decode_tokens this "

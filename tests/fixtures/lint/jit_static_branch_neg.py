@@ -1,0 +1,39 @@
+"""Negatives: static-arg branches, presence checks, shadowed names and
+un-jitted helpers must not trip jit-static-branch."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def segment(x, mask, *, iters, chunk):
+    if chunk > 1 and iters > 0:  # both declared static below
+        x = x * 2
+    if mask is not None:  # presence check: static at trace time
+        x = jnp.where(mask, x, 0.0)
+    if x.ndim == 2 and x.shape[0] > 1:  # shape metadata: static too
+        x = x[:1]
+
+    def inner(chunk):  # shadows the outer param: its own local
+        if chunk:
+            return 1
+        return 0
+
+    return x + inner(0)
+
+
+jit_segment = jax.jit(segment, static_argnames=("iters", "chunk"))
+
+
+@functools.partial(jax.jit, static_argnames=("mode",))
+def partial_jit(x, mode):
+    if mode:
+        x = x * 3
+    return x
+
+
+def plain_helper(x, flag):  # never jitted: Python branching is fine
+    if flag:
+        return x + 1
+    return x

@@ -1,0 +1,35 @@
+"""Positives: Python branches on jitted-function parameters that are NOT
+declared static — decided at trace time, the hetero-refactor bug class."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def segment(x, temperature, constrained):
+    if constrained:  # not static -> trace-time branch
+        x = x * 2
+    while temperature > 0:  # while on a traced param: same bug
+        x = x + 1
+        temperature = -1.0
+    return x
+
+
+jit_segment = jax.jit(segment)
+
+
+@jax.jit
+def decorated(x, flag):
+    if flag:  # bare @jax.jit: nothing is static
+        return x + 1
+    return x
+
+
+@functools.partial(jax.jit, static_argnames=("mode",))
+def partial_jit(x, mode, gate):
+    if mode:  # static: fine (negative inline)
+        x = x * 3
+    if gate and mode:  # 'gate' is traced -> positive
+        x = jnp.abs(x)
+    return x

@@ -697,3 +697,151 @@ def test_draft_speculation_concurrent_rows_allocator_clean():
             await eng.aclose()
 
     asyncio.run(go())
+
+
+def test_hetero_mixed_slab_matches_homogeneous():
+    """Heterogeneous batching tentpole: constrained greedy, a second
+    grammar, free-form and temperature>0 requests share ONE slab
+    (hetero_batch=on), and every deterministic row's output is
+    byte-identical to its solo run — on the hetero engine AND on a
+    hetero_batch=off engine (greedy parity across both modes). Stochastic
+    rows stay legal DFA prefixes. Nothing leaks."""
+    from mcpx.planner.grammar import build_plan_grammar
+
+    async def go():
+        eng = make_engine(hetero_batch=True, max_batch_size=6)
+        eng_off = make_engine(max_batch_size=6)
+        await eng.start()
+        await eng_off.start()
+        try:
+            tok = eng.tokenizer
+            p_plan = tok.encode("plan: compose the services. JSON:")
+            p_free = tok.encode("free-form hello there")
+            g2 = build_plan_grammar(tok, ["svc-a", "svc-b", "rank-c"])
+            g2_off = build_plan_grammar(eng_off.tokenizer, ["svc-a", "svc-b", "rank-c"])
+
+            solo_plan = await eng.generate(p_plan, max_new_tokens=48)
+            solo_free = await eng.generate(p_free, max_new_tokens=12, constrained=False)
+            solo_g2 = await eng.generate(p_plan, max_new_tokens=48, grammar=g2)
+            # Greedy parity with the homogeneous engine (same deterministic
+            # weights): per-row tables/sampling change nothing token-wise.
+            off_plan = await eng_off.generate(p_plan, max_new_tokens=48)
+            off_free = await eng_off.generate(p_free, max_new_tokens=12, constrained=False)
+            off_g2 = await eng_off.generate(p_plan, max_new_tokens=48, grammar=g2_off)
+            assert solo_plan.text == off_plan.text
+            assert solo_free.token_ids == off_free.token_ids
+            assert solo_g2.text == off_g2.text
+
+            # The mixed slab: all five classes at once, strict queue order.
+            mixed = await asyncio.gather(
+                eng.generate(p_plan, max_new_tokens=48),
+                eng.generate(p_free, max_new_tokens=12, constrained=False),
+                eng.generate(p_plan, max_new_tokens=48, grammar=g2),
+                eng.generate(p_plan, max_new_tokens=48, temperature=0.9),
+                eng.generate(p_free, max_new_tokens=12, constrained=False, temperature=0.9),
+            )
+            assert mixed[0].text == solo_plan.text
+            assert mixed[1].token_ids == solo_free.token_ids
+            assert mixed[2].text == solo_g2.text
+            assert '"s":"svc-' in mixed[2].text or '"s":"rank-' in mixed[2].text
+            # Stochastic constrained row: still a legal plan prefix.
+            assert eng.grammar.walk(mixed[3].text) != eng.grammar.dead_state
+            assert mixed[4].generated_tokens <= 12
+            stats = eng._allocator.stats()
+            assert stats.sequences == 0
+            eng._allocator.check_invariants()
+            qs = eng.queue_stats()
+            assert {"depth_constrained", "depth_free", "hol_wait_ms", "resident_grammars"} <= set(qs)
+        finally:
+            await eng.aclose()
+            await eng_off.aclose()
+
+    asyncio.run(go())
+
+
+def test_hetero_segment_compiles_once_across_grammar_mix():
+    """Executable-count acceptance: after the first heterogeneous segment
+    compiles, introducing NEW grammars, an unconstrained row and a second
+    temperature triggers ZERO further XLA compiles of the hetero segment —
+    temperature/constrained are device values and grammars are stacked
+    table DATA, not static args."""
+    import logging
+
+    import jax
+
+    from mcpx.planner.grammar import build_plan_grammar
+
+    compiles: list[str] = []
+
+    class _Counter(logging.Handler):
+        def emit(self, rec):
+            msg = rec.getMessage()
+            if "_hetero_segment_impl" in msg and "Compiling" in msg:
+                compiles.append(msg)
+
+    logger = logging.getLogger("jax._src.interpreters.pxla")
+    handler = _Counter()
+    old_level = logger.level
+    logger.addHandler(handler)
+    logger.setLevel(logging.DEBUG)
+    jax.config.update("jax_log_compiles", True)
+
+    async def go():
+        eng = make_engine(hetero_batch=True)
+        await eng.start()
+        try:
+            p = eng.tokenizer.encode("plan: compose. JSON:")
+            await eng.generate(p, max_new_tokens=24)
+            n0 = len(compiles)
+            assert n0 >= 1, "first hetero segment never compiled?"
+            g1 = build_plan_grammar(eng.tokenizer, ["svc-a", "svc-b"])
+            g2 = build_plan_grammar(eng.tokenizer, ["other-x", "other-y"])
+            await asyncio.gather(
+                eng.generate(p, max_new_tokens=24, grammar=g1),
+                eng.generate(p, max_new_tokens=24, grammar=g2, temperature=0.7),
+                eng.generate(eng.tokenizer.encode("free"), max_new_tokens=8, constrained=False),
+            )
+            assert len(compiles) == n0, (
+                f"hetero segment recompiled for new grammars/configs: "
+                f"{len(compiles) - n0} extra compiles"
+            )
+        finally:
+            await eng.aclose()
+
+    try:
+        asyncio.run(go())
+    finally:
+        jax.config.update("jax_log_compiles", False)
+        logger.removeHandler(handler)
+        logger.setLevel(old_level)
+
+
+def test_hetero_grammar_slots_recycle_and_defer():
+    """More distinct grammars than stacked slots: the overflow grammar's
+    request defers until a resident grammar drains, then admits and
+    completes — strict queue order otherwise, and slot refcounts return to
+    zero at the end."""
+    from mcpx.planner.grammar import build_plan_grammar
+
+    async def go():
+        # 2 slots = trivial + ONE constrained grammar resident at a time.
+        eng = make_engine(hetero_batch=True, hetero_grammar_slots=2)
+        await eng.start()
+        try:
+            tok = eng.tokenizer
+            p = tok.encode("plan: q. JSON:")
+            g1 = build_plan_grammar(tok, ["aaa-svc"])
+            g2 = build_plan_grammar(tok, ["bbb-svc"])
+            r1, r2 = await asyncio.gather(
+                eng.generate(p, max_new_tokens=32, grammar=g1),
+                eng.generate(p, max_new_tokens=32, grammar=g2),
+            )
+            assert '"s":"aaa-svc"' in r1.text
+            assert '"s":"bbb-svc"' in r2.text
+            assert eng.queue_stats()["resident_grammars"] == 0
+            assert eng._allocator.stats().sequences == 0
+            eng._allocator.check_invariants()
+        finally:
+            await eng.aclose()
+
+    asyncio.run(go())

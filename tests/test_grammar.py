@@ -593,3 +593,55 @@ def test_sparse_free_strings_exceed_budget():
     finally:
         G._DENSE_ENTRIES_MAX, G._DENSE_SUBWORD_MAX = old_dense
         G._SPARSE_VISIT_BUDGET = old_budget
+
+
+def test_stacked_tables_step_identical_to_single():
+    """Heterogeneous batching stacks several grammars' compact tables along
+    a leading slot axis (engine per-row dfa_id indexing). Stepping through
+    the stacked tables must be token-for-token identical to stepping the
+    original per-grammar tables — legal sets, transitions, eos columns,
+    active ids and distance-to-accept all agree at every state of random
+    legal walks, per grammar, per slot."""
+    import random
+
+    from mcpx.planner.grammar import build_trivial_grammar, stacked_tables
+
+    tok = ByteTokenizer()
+    g_plain = build_plan_grammar(tok)
+    g_trie = build_plan_grammar(tok, ["svc-a", "svc-b", "other-name"])
+    triv = build_trivial_grammar(tok)
+    strans, smask, sdist, sids, seos = stacked_tables([triv, g_plain, g_trie])
+    assert strans.shape[0] == 3 and strans.shape == smask.shape
+    for gi, g in ((1, g_plain), (2, g_trie)):
+        C = g.n_active
+        assert np.array_equal(sids[gi, :C], g.active_ids)
+        assert np.array_equal(seos[gi, :C], g.eos_cols)
+        assert not smask[gi, :, C:].any()  # padding columns inert
+        assert np.array_equal(sdist[gi, : g.n_states], g.dist)
+        rng = random.Random(gi)
+        for _walk in range(10):
+            s = g.start_state
+            for _step in range(80):
+                legal = np.flatnonzero(g.cmask[s])
+                assert np.array_equal(legal, np.flatnonzero(smask[gi, s]))
+                if len(legal) == 0:
+                    break
+                c = int(rng.choice(list(legal)))
+                if g.eos_cols[c]:
+                    break
+                nxt = int(g.ctrans[s, c])
+                assert nxt == int(strans[gi, s, c])
+                s = nxt
+
+
+def test_trivial_grammar_never_forces_and_accepts_everything():
+    """The trivial slot-0 DFA (unconstrained rows): grammar fast-forward
+    forces a token only when exactly ONE column is legal, so no trivial
+    state may have a single-column mask; host-side walk accepts any text."""
+    from mcpx.planner.grammar import build_trivial_grammar
+
+    g = build_trivial_grammar()
+    assert not (g.cmask.sum(axis=1) == 1).any()
+    for text in ["", "anything at all", '{"not":"a plan"}', "\x00\xff"]:
+        assert g.is_accept(g.walk(text)) or g.walk(text) == g.start_state
+    assert g.is_accept(g.walk("free text"))

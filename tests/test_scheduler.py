@@ -336,7 +336,18 @@ def test_engine_queue_stats_surface():
     )
     eng = InferenceEngine(cfg)
     st = eng.queue_stats()
-    assert st == {"depth": 0, "active": 0, "service_ewma_s": 0.0, "eta_s": 0.0}
+    assert st == {
+        "depth": 0,
+        "active": 0,
+        "service_ewma_s": 0.0,
+        "eta_s": 0.0,
+        # Heterogeneous-batching additions: per-class backlog, head-of-line
+        # age, resident stacked grammars — all zero on a cold engine.
+        "depth_constrained": 0,
+        "depth_free": 0,
+        "hol_wait_ms": 0.0,
+        "resident_grammars": 0,
+    }
     eng._ewma_service_s = 2.0
     for _ in range(5):  # 4 fit the free slab rows; 1 overflows = 1 drain
         eng._queue.put(object())
