@@ -50,6 +50,13 @@ Environment knobs:
     MCPX_BENCH_HETERO    1 = serve the HEADLINE phases with
                          engine.hetero_batch on too (default 0 keeps the
                          headline comparable to earlier rounds)
+    MCPX_BENCH_TRACE     0 skips the latency-attribution phase (default on):
+                         a short open-loop round at the phase-2 rate with
+                         the request tracer attached — reports p50/p99
+                         scheduler-queue/admit-wait/prefill/decode/tool
+                         shares in the output JSON (headline phases always
+                         run tracing-disabled)
+    MCPX_BENCH_TRACE_REQUESTS     attribution-phase request count (default 96)
     MCPX_BENCH_OVERLOAD_FACTOR    offered load as a multiple of measured
                                   throughput (default 4)
     MCPX_BENCH_OVERLOAD_REQUESTS  overload-phase request count (default 256)
@@ -265,6 +272,12 @@ def _build_config(model_size: str):
                 # minutes of compile for buckets it will never time fairly).
                 "warmup_compile": os.environ.get("MCPX_BENCH_WARMUP", "1") != "0",
             },
+            # Headline phases run tracing-DISABLED so the timed numbers stay
+            # comparable to earlier rounds (and the acceptance criterion
+            # "tracing off = no measurable regression" is the configuration
+            # actually measured). The latency-attribution phase attaches its
+            # own Tracer to the live control plane afterwards.
+            "tracing": {"enabled": False},
             "planner": {
                 "kind": "llm",
                 # One constrained decode per plan; validation failures repair
@@ -679,6 +692,97 @@ async def _mixed_phase(cp, overload: "dict | None") -> "dict | None":
     }
 
 
+# Span names -> attribution phase keys (tracing spine, mcpx/telemetry/
+# tracing.py). Per request: scheduler queue wait, engine admit-wait
+# (enqueue -> admission prefill start), cohort prefill, slab-resident
+# decode, and downstream tool/microservice attempts (/plan has none; the
+# key exists so /plan_and_execute workloads report it too).
+_ATTR_PHASES = {
+    "sched_queue": ("sched.acquire",),
+    "engine_queue": ("engine.queue_wait",),
+    "prefill": ("engine.prefill",),
+    "decode": ("engine.decode",),
+    "tools": ("attempt",),
+}
+
+
+def _attribution_from_traces(recs) -> "dict | None":
+    """p50/p99 per-phase latency attribution over sampled trace records:
+    where a request's wall time went, so a BENCH_*.json regression explains
+    itself instead of just reporting a bigger p50 (ISSUE 4 satellite)."""
+    rows = []
+    for rec in recs:
+        if rec.error:
+            continue  # error traces attribute failure, not steady-state latency
+        phases = {k: 0.0 for k in _ATTR_PHASES}
+        for s in rec.spans:
+            for key, names in _ATTR_PHASES.items():
+                if s.name in names:
+                    phases[key] += s.duration_ms
+        phases["total"] = rec.total_ms
+        rows.append(phases)
+    if not rows:
+        return None
+
+    def q(vals: list, p: float) -> float:
+        vs = sorted(vals)
+        return vs[min(len(vs) - 1, int(p * (len(vs) - 1)))]
+
+    keys = [*_ATTR_PHASES, "total"]
+    p50 = {k: round(q([r[k] for r in rows], 0.5), 2) for k in keys}
+    p99 = {k: round(q([r[k] for r in rows], 0.99), 2) for k in keys}
+    tot = max(1e-9, p50["total"])
+    return {
+        "traces": len(rows),
+        "p50_ms": p50,
+        "p99_ms": p99,
+        # Share of the p50 request: the number to read when a regression
+        # lands — which phase grew. Shares need not sum to 1 (phases
+        # overlap the un-instrumented remainder: HTTP parse, validation,
+        # prompt build, host dispatch).
+        "share_p50": {k: round(p50[k] / tot, 4) for k in _ATTR_PHASES},
+    }
+
+
+async def _attribution_phase(cp, base: str, records, rng, rate: float) -> "dict | None":
+    """Latency-attribution sample (tracing spine): a short open-loop round
+    at the phase-2 offered rate with a Tracer attached to the LIVE control
+    plane (cp.tracer is read per request by the middleware), detached in a
+    finally. Its own phase, after every headline scrape, so the headline
+    p50 stays tracing-free and comparable to earlier rounds. Skip with
+    MCPX_BENCH_TRACE=0."""
+    if os.environ.get("MCPX_BENCH_TRACE", "1") == "0":
+        return None
+    from aiohttp import ClientSession, TCPConnector
+
+    from mcpx.telemetry.tracing import Tracer
+    from mcpx.utils.synth import intent_for
+
+    n = int(os.environ.get("MCPX_BENCH_TRACE_REQUESTS", "96"))
+    rate = max(0.5, rate)
+    prev = cp.tracer
+    cp.tracer = Tracer(enabled=True, sample_rate=1.0, ring_size=max(1024, n))
+    try:
+        async with ClientSession(connector=TCPConnector(limit=0)) as session:
+
+            async def one(intent: str, delay: float) -> None:
+                await asyncio.sleep(delay)
+                try:
+                    async with session.post(
+                        f"{base}/plan", json={"intent": intent}
+                    ) as resp:
+                        await resp.json()
+                except Exception:  # noqa: BLE001 - a failed request simply contributes no trace
+                    pass
+
+            intents = [f"{intent_for(records, rng)} [attr{i}]" for i in range(n)]
+            await asyncio.gather(*(one(x, i / rate) for i, x in enumerate(intents)))
+        recs = cp.tracer.traces()
+    finally:
+        cp.tracer = prev
+    return _attribution_from_traces(recs)
+
+
 async def _run(model_size: str, n_requests: int, concurrency: int, n_services: int) -> dict:
     from aiohttp import ClientSession, TCPConnector
     from aiohttp.test_utils import TestServer
@@ -861,10 +965,15 @@ async def _run(model_size: str, n_requests: int, concurrency: int, n_services: i
         # headline scrape so attaching the scheduler cannot perturb them.
         overload = await _overload_phase(cp, base, records, rng, plans_per_sec)
 
-        # ---- Phase 4: heterogeneous mixed-traffic (ISSUE 3) — last, so
-        # flipping hetero_batch on the live engine can't touch any earlier
-        # number.
+        # ---- Phase 4: heterogeneous mixed-traffic (ISSUE 3) — last of the
+        # perf phases, so flipping hetero_batch on the live engine can't
+        # touch any earlier number.
         mixed = await _mixed_phase(cp, overload)
+
+        # ---- Phase 5: latency attribution (ISSUE 4) — a traced open-loop
+        # sample at the phase-2 rate; runs dead last because attaching the
+        # tracer is the one thing this phase does that others must not see.
+        attribution = await _attribution_phase(cp, base, records, rng, rate)
 
     finally:
         # Teardown in a FINALLY: a cancelled run (MCPX_BENCH_RUN_TIMEOUT_S
@@ -932,6 +1041,12 @@ async def _run(model_size: str, n_requests: int, concurrency: int, n_services: i
         # mixed_plans_per_sec hetero vs drain at the same offered load,
         # head-of-line wait p99, degraded_share.
         "mixed": mixed,
+        # Per-phase latency attribution from sampled request traces (None
+        # when skipped): p50/p99 of scheduler-queue vs engine admit-wait vs
+        # prefill vs decode vs tool fan-out, plus each phase's share of the
+        # p50 request — BENCH_*.json explains regressions, not just
+        # reports them.
+        "latency_attribution": attribution,
         "plan_quality": quality,
         "plans_per_sec": plans_per_sec,
         "p50_ms": statistics.median(open_sorted),
@@ -1227,6 +1342,7 @@ def main() -> None:
                 "errors": stats["errors"],
                 "overload": stats["overload"],
                 "mixed": stats["mixed"],
+                "latency_attribution": stats["latency_attribution"],
                 "grammar_fallback": stats["grammar_fallback"],
                 "cache_hit_share": round(stats["cache_hit_share"], 4),
                 "unique_intents": stats["unique_intents"],

@@ -2,4 +2,9 @@
 with the core registry; add a module here (and import it below) to ship a
 new rule — see docs/static-analysis.md."""
 
-from mcpx.analysis.rules import async_rules, jax_rules, style_rules  # noqa: F401
+from mcpx.analysis.rules import (  # noqa: F401
+    async_rules,
+    jax_rules,
+    style_rules,
+    tracing_rules,
+)

@@ -1,0 +1,103 @@
+"""Tracing-hygiene rule: manual clock deltas that straddle an await in
+request-path async code should be tracing spans (mcpx/telemetry/tracing.py).
+
+A ``t0 = time.monotonic()`` … ``await …`` … ``time.monotonic() - t0`` pair
+measures a request-path interval — exactly what a span records, except the
+manual delta is invisible to ``GET /traces``, carries no trace id, and
+cannot be attributed against the rest of the request. Findings point the
+author at ``tracing.span``; sites whose number must exist with tracing off
+(client-facing latency fields, Prometheus observations) suppress with a
+justification, same contract as every other rule.
+
+Offline measurement harnesses are exempt by path (any ``benchmarks/``
+segment): wall-clock deltas are their *product*, not a missed span.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from mcpx.analysis.core import FileContext, Finding, rule
+from mcpx.analysis.rules.common import (
+    async_functions,
+    call_name,
+    dotted_name,
+    walk_scope,
+)
+
+# Direct clock reads. Event-loop clocks are matched structurally below
+# (loop.time() / self._loop.time() / asyncio.get_event_loop().time()) —
+# the executor's idiom.
+_TIMING_NAMES = {"time.time", "time.monotonic", "time.perf_counter"}
+_LOOP_FACTORIES = {"asyncio.get_event_loop", "asyncio.get_running_loop"}
+
+
+def _is_timing_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = call_name(node)
+    if name in _TIMING_NAMES:
+        return True
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr == "time":
+        base = dotted_name(f.value)
+        if base is not None and "loop" in base.lower():
+            return True
+        if isinstance(f.value, ast.Call) and call_name(f.value) in _LOOP_FACTORIES:
+            return True
+    return False
+
+
+@rule(
+    "span-across-await-blocking",
+    "manual clock delta spanning an await in request-path async code — "
+    "record a tracing span instead",
+)
+def check_span_across_await(ctx: FileContext) -> Iterator[Finding]:
+    """Flags a subtraction involving a variable assigned from a clock call
+    when at least one yield point (``await`` / ``async for`` / ``async
+    with``) sits between the assignment and the use — the measured interval
+    is request-path latency that belongs in the trace tree."""
+    parts = ctx.relpath.split("/")
+    if "benchmarks" in parts:
+        return
+    for fn in async_functions(ctx.tree):
+        yields = sorted(
+            n.lineno
+            for n in walk_scope(fn)
+            if isinstance(n, (ast.Await, ast.AsyncFor, ast.AsyncWith))
+        )
+        if not yields:
+            continue
+        assigns: dict[str, list[int]] = {}
+        for node in walk_scope(fn):
+            if isinstance(node, ast.Assign) and _is_timing_call(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        assigns.setdefault(t.id, []).append(node.lineno)
+        if not assigns:
+            continue
+        for node in walk_scope(fn):
+            if not (isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub)):
+                continue
+            for side in (node.left, node.right):
+                if not (isinstance(side, ast.Name) and side.id in assigns):
+                    continue
+                prior = [a for a in assigns[side.id] if a < node.lineno]
+                if not prior:
+                    continue
+                # Judge against the LATEST assignment before the use: a
+                # re-read of the clock after the await resets the interval.
+                a0 = max(prior)
+                if any(a0 < y <= node.lineno for y in yields):
+                    yield ctx.finding(
+                        node.lineno,
+                        "span-across-await-blocking",
+                        f"manual timing delta on '{side.id}' spans an await "
+                        f"in async '{fn.name}' — record it as a tracing span "
+                        "(mcpx.telemetry.tracing.span) so it lands in the "
+                        "request trace; suppress only where the number must "
+                        "exist with tracing off",
+                    )
+                    break

@@ -28,11 +28,20 @@ def _load_config(args: argparse.Namespace) -> MCPXConfig:
 
 
 def cmd_serve(args: argparse.Namespace) -> int:
+    import os
+
     from aiohttp import web
 
     from mcpx.server.app import build_app
     from mcpx.server.factory import build_control_plane
+    from mcpx.telemetry.tracing import configure_logging
 
+    # Every log line carries the active request's trace_id/span_id
+    # (tracing spine); MCPX_LOG_JSON=1 or --log-json switches to one JSON
+    # object per line for log pipelines.
+    configure_logging(
+        json_logs=bool(args.log_json or os.environ.get("MCPX_LOG_JSON") == "1")
+    )
     cfg = _load_config(args)
     if args.port:
         cfg.server.port = args.port
@@ -40,6 +49,64 @@ def cmd_serve(args: argparse.Namespace) -> int:
     app = build_app(cp)
     web.run_app(app, host=cfg.server.host, port=cfg.server.port)
     return 0
+
+
+def _http_json(url: str, timeout_s: float = 10.0):
+    """GET ``url`` → parsed JSON. Sync CLI context — urllib is fine here
+    (no event loop to block) and saves an aiohttp session for one call."""
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+            return json.loads(resp.read().decode())
+    except urllib.error.HTTPError as e:
+        try:
+            detail = json.loads(e.read().decode()).get("error", "")
+        except Exception:  # mcpx: ignore[broad-except] - error body is best-effort detail; the HTTPError itself is re-raised below
+            detail = ""
+        raise RuntimeError(f"{url}: HTTP {e.code} {detail}".strip()) from e
+    except (urllib.error.URLError, OSError) as e:
+        raise RuntimeError(f"{url}: {e}") from e
+
+
+def cmd_trace(args: argparse.Namespace) -> int:
+    """Inspect/export the server's retained traces (tracing spine,
+    docs/observability.md). ``list`` prints ring summaries; ``dump`` writes
+    one trace as Chrome trace-event JSON that loads in Perfetto
+    (ui.perfetto.dev) or chrome://tracing."""
+    base = args.url.rstrip("/")
+    try:
+        if args.action == "list":
+            out = _http_json(f"{base}/traces")
+            print(json.dumps(out, indent=2))
+            return 0
+        # dump: explicit --id, else the newest retained trace.
+        trace_id = args.id
+        if not trace_id:
+            traces = _http_json(f"{base}/traces").get("traces", [])
+            if not traces:
+                print(json.dumps({"error": "no traces retained on the server"}))
+                return 1
+            trace_id = traces[0]["trace_id"]
+        chrome = _http_json(f"{base}/traces/{trace_id}?format=chrome")
+        out_path = args.out or f"trace_{trace_id}.json"
+        with open(out_path, "w") as f:
+            json.dump(chrome, f)
+        print(
+            json.dumps(
+                {
+                    "trace_id": trace_id,
+                    "wrote": out_path,
+                    "events": len(chrome.get("traceEvents", [])),
+                    "open_with": "https://ui.perfetto.dev (Open trace file)",
+                }
+            )
+        )
+        return 0
+    except RuntimeError as e:
+        print(json.dumps({"error": str(e)}))
+        return 1
 
 
 def cmd_validate(args: argparse.Namespace) -> int:
@@ -187,7 +254,29 @@ def main(argv: list[str] | None = None) -> int:
 
     p_serve = sub.add_parser("serve", help="run the control-plane server")
     p_serve.add_argument("--port", type=int, default=0)
+    p_serve.add_argument(
+        "--log-json", action="store_true",
+        help="one JSON object per log line (trace_id/span_id fields included)",
+    )
     p_serve.set_defaults(func=cmd_serve)
+
+    p_trace = sub.add_parser(
+        "trace", help="inspect/export request traces from a running server"
+    )
+    p_trace.add_argument("action", choices=["list", "dump"])
+    p_trace.add_argument(
+        "--url", default="http://127.0.0.1:8000",
+        help="server base URL (default: %(default)s)",
+    )
+    p_trace.add_argument(
+        "--id", default="",
+        help="trace id to dump (default: the newest retained trace)",
+    )
+    p_trace.add_argument(
+        "--out", default="",
+        help="output path for dump (default: trace_<id>.json)",
+    )
+    p_trace.set_defaults(func=cmd_trace)
 
     p_val = sub.add_parser("validate", help="validate a plan JSON file")
     p_val.add_argument("file", help="path or - for stdin")

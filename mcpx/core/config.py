@@ -326,8 +326,31 @@ class SchedulerConfig:
 
 
 @dataclass
+class TracingConfig:
+    """End-to-end request tracing (mcpx/telemetry/tracing.py): the span
+    spine every request carries from HTTP ingress to response. Disabled is
+    a TRUE no-op — no root span, no contextvar, no engine-side span work on
+    the decode hot path (GenerateRequest.span stays None)."""
+
+    enabled: bool = True
+    # Head sampling: probability a completed trace is retained in the ring.
+    # Error and SLO-breach traces are retained regardless (tail sampling).
+    sample_rate: float = 1.0
+    # Completed traces kept in memory (GET /traces; oldest evicted first).
+    ring_size: int = 256
+    # Tail sampling: always keep traces whose request errored…
+    keep_errors: bool = True
+    # …and traces slower end-to-end than this many ms (0 disables).
+    slo_breach_ms: float = 0.0
+    # Attach exemplar trace ids to latency histograms (rendered only in the
+    # OpenMetrics exposition; plain Prometheus text ignores them).
+    exemplars: bool = True
+
+
+@dataclass
 class MCPXConfig:
     server: ServerConfig = field(default_factory=ServerConfig)
+    tracing: TracingConfig = field(default_factory=TracingConfig)
     scheduler: SchedulerConfig = field(default_factory=SchedulerConfig)
     registry: RegistryConfig = field(default_factory=RegistryConfig)
     model: ModelConfig = field(default_factory=ModelConfig)
@@ -454,6 +477,13 @@ class MCPXConfig:
                 "scheduler thresholds must satisfy 0 < recover_threshold "
                 f"({s.recover_threshold}) < degrade_threshold ({s.degrade_threshold})"
             )
+        t = self.tracing
+        if not 0.0 <= t.sample_rate <= 1.0:
+            problems.append("tracing.sample_rate must be in [0, 1]")
+        if t.ring_size < 1:
+            problems.append("tracing.ring_size must be >= 1")
+        if t.slo_breach_ms < 0:
+            problems.append("tracing.slo_breach_ms must be >= 0 (0 = off)")
         if self.retrieval.shortlist_mode not in ("residual", "topk"):
             problems.append(
                 f"retrieval.shortlist_mode '{self.retrieval.shortlist_mode}' "

@@ -78,6 +78,7 @@ from mcpx.planner.grammar import (
     stacked_tables,
 )
 from mcpx.scheduler.admission import ewma_update
+from mcpx.telemetry import tracing
 from mcpx.telemetry.metrics import Metrics
 
 log = logging.getLogger("mcpx.engine")
@@ -101,6 +102,12 @@ class GenerateRequest:
     # ONCE into read-only KV pages shared by every row's page table, and
     # per-request prefill covers only the suffix. 0 disables.
     shared_prefix_len: int = 0
+    # Tracing parent (telemetry/tracing.Span) for engine-side attribution:
+    # the worker thread hangs queue-wait / prefill / per-segment decode
+    # child spans off it via explicit parent.child(t0=..., t1=...) calls —
+    # no contextvar crosses the thread boundary. None (tracing disabled or
+    # request unsampled) keeps the decode hot path entirely span-free.
+    span: Optional[Any] = None
 
     def prefix_key(self, page_size: int) -> Optional[tuple]:
         """Page-aligned shared prefix as the cache key (None = no sharing).
@@ -204,6 +211,11 @@ class _Slab:
         self.constrained = True
         self.temperature = 0.0
         self.grammar: Optional[PlanGrammar] = None
+        # Rows whose request carries a tracing span (GenerateRequest.span).
+        # Zero = the common disabled/unsampled case: every per-segment
+        # tracing branch in the worker collapses to one int comparison and
+        # the decode hot path allocates nothing for tracing.
+        self.n_traced = 0
         # The batching mode the CURRENT occupancy was admitted under,
         # latched whenever the slab refills from empty: rows admitted under
         # one mode carry that mode's page-slack geometry, so a live
@@ -236,6 +248,9 @@ class _Slab:
         )
 
     def clear_row(self, i: int) -> None:
+        r = self.req[i]
+        if r is not None and r.span is not None:
+            self.n_traced -= 1
         self.req[i] = None
         self.sid[i] = None
         self.done[i] = True
@@ -488,19 +503,33 @@ class InferenceEngine:
         if self.state != "ready":
             raise EngineError(f"engine not ready (state={self.state})")
         ecfg = self.config.engine
-        req = GenerateRequest(
-            prompt_ids=list(prompt_ids),
-            max_new_tokens=max_new_tokens or ecfg.max_decode_len,
+        with tracing.span(
+            "engine.generate",
+            prompt_tokens=len(prompt_ids),
             constrained=constrained,
-            temperature=ecfg.temperature if temperature is None else temperature,
-            future=asyncio.get_running_loop().create_future(),
-            loop=asyncio.get_running_loop(),
-            enqueued_at=time.monotonic(),
-            grammar=grammar,
-            shared_prefix_len=shared_prefix_len if ecfg.prefix_cache else 0,
-        )
-        self._queue.put(req)
-        return await req.future
+        ) as esp:
+            req = GenerateRequest(
+                prompt_ids=list(prompt_ids),
+                max_new_tokens=max_new_tokens or ecfg.max_decode_len,
+                constrained=constrained,
+                temperature=ecfg.temperature if temperature is None else temperature,
+                future=asyncio.get_running_loop().create_future(),
+                loop=asyncio.get_running_loop(),
+                enqueued_at=time.monotonic(),
+                grammar=grammar,
+                shared_prefix_len=shared_prefix_len if ecfg.prefix_cache else 0,
+                span=esp,
+            )
+            self._queue.put(req)
+            res = await req.future
+            if esp is not None:
+                esp.set(
+                    tokens=res.generated_tokens,
+                    queue_ms=round(res.queue_ms, 3),
+                    prefill_ms=round(res.prefill_ms, 3),
+                    decode_ms=round(res.decode_ms, 3),
+                )
+            return res
 
     def queue_stats(self) -> dict:
         """Cross-thread snapshot of engine load for the serving scheduler
@@ -1130,7 +1159,7 @@ class InferenceEngine:
         this replaces."""
         now = time.monotonic()
         while self._pending_admissions:
-            t0, marker, rows, gens = self._pending_admissions[0]
+            t0, marker, rows, gens, t_admit0 = self._pending_admissions[0]
             if not marker.is_ready():
                 # Purge entries whose rows were ALL cancelled/reaped before
                 # the marker resolved — otherwise they hold device handles
@@ -1150,6 +1179,18 @@ class InferenceEngine:
                     continue
                 slab.prefill_ms[i] = dt
                 slab.t_decode0[i] = now
+                r = slab.req[i]
+                if r.span is not None:
+                    # Admission-start to chain-completion: host prep, the
+                    # cohort prefill this row rode in, commit-to-pages and
+                    # first sample (observed <=1 tick late, same as the
+                    # prefill_ms it mirrors).
+                    r.span.child(
+                        "engine.prefill",
+                        t0=t_admit0,
+                        t1=now,
+                        dfa_id=int(slab.dfa[i]),
+                    )
 
     def _dispatch_merge(self, slab: "_Slab", rows: list[int]) -> None:
         """Dispatch one clear-scatter for ``rows`` + any dirty retired rows
@@ -2560,6 +2601,19 @@ class InferenceEngine:
             self.metrics.hol_wait.observe(slab.queue_ms[i])
             slab.prefill_ms[i] = -1.0  # resolved by _poll_admissions
             slab.t_decode0[i] = t1
+            if r.span is not None:
+                # Queue-wait (enqueue -> admission-prefill start): the
+                # HoL/admit-wait attribution the hetero-batching bench
+                # phases care about, now per request instead of only as a
+                # histogram.
+                slab.n_traced += 1
+                r.span.child(
+                    "engine.queue_wait",
+                    t0=r.enqueued_at,
+                    t1=t0,
+                    cls="constrained" if r.constrained else "free",
+                    row=i,
+                )
             if prefix is not None:
                 prefix.refs += 1
                 slab.prefix[i] = prefix
@@ -2612,7 +2666,7 @@ class InferenceEngine:
             self._reset_pools()
             return
         self._pending_admissions.append(
-            (t1, slab.dev[4], rows_idx, [int(slab.gen[i]) for i in rows_idx])
+            (t1, slab.dev[4], rows_idx, [int(slab.gen[i]) for i in rows_idx], t0)
         )
         self.metrics.kv_page_utilization.set(self._allocator.stats().utilization)
         self.metrics.batch_occupancy.set(slab.n_active)
@@ -2719,7 +2773,10 @@ class InferenceEngine:
             cur_d, pos_d, st_d, e_d, done_d, budgets_d, pt_d, buf_d,
             ptoks_d, plens_d, prev_d, temp_d, cons_d, dfa_d,
         )
-        self._inflight.append((done_d, e_d, buf_d, n_fwd, slab.gen.copy()))
+        # Dispatch timestamp only when some resident request is traced: the
+        # disabled/unsampled hot path must not even pay the clock read.
+        t_disp = time.monotonic() if slab.n_traced else 0.0
+        self._inflight.append((done_d, e_d, buf_d, n_fwd, slab.gen.copy(), t_disp))
 
     def _harvest(self, slab: "_Slab", keep_inflight: int) -> None:
         """Fetch flags + out_buf of in-flight segments (oldest first) until
@@ -2731,7 +2788,7 @@ class InferenceEngine:
         against a done-flag from before a row was re-admitted retiring the
         row's NEW request."""
         while len(self._inflight) > keep_inflight:
-            done_d, e_d, buf_d, nfwd_d, gen_snap = self._inflight.popleft()
+            done_d, e_d, buf_d, nfwd_d, gen_snap, t_disp = self._inflight.popleft()
             # ONE combined fetch (flags + out_buf): the tunnel's cost is the
             # round trip (~72ms), not the ~24KB of buffer — splitting into
             # flags-then-buf would add a second round trip on every
@@ -2746,6 +2803,29 @@ class InferenceEngine:
             # what the caller actually waits for.
             t1 = time.monotonic()
             self.metrics.decode_forwards.inc(int(n_fwd))
+            if t_disp:
+                # Per-segment decode attribution for traced rows: dispatch
+                # to (lagged) harvest, per-row token delta against the host
+                # emitted mirror (valid per row lifetime: cleared to 0 at
+                # admission, advanced only here), the row's grammar slot and
+                # sampling class — the hetero-batching attribution unit.
+                for i in range(slab.B):
+                    r = slab.req[i]
+                    if r is None or r.span is None or gen_snap[i] != slab.gen[i]:
+                        continue
+                    delta = int(e[i]) - int(slab.emitted[i])
+                    slab.emitted[i] = e[i]
+                    if delta <= 0 and not done[i]:
+                        continue
+                    r.span.child(
+                        "engine.segment",
+                        t0=t_disp,
+                        t1=t1,
+                        tokens=delta,
+                        dfa_id=int(slab.dfa[i]),
+                        cls="constrained" if slab.cons[i] else "free",
+                        forwards=int(n_fwd),
+                    )
             retired = False
             for i in range(slab.B):
                 r = slab.req[i]
@@ -2773,7 +2853,28 @@ class InferenceEngine:
                 self.metrics.decode_tokens.inc(len(ids))
                 self.metrics.engine_queue_seconds.observe(res.queue_ms / 1e3)
                 self.metrics.engine_prefill_seconds.observe(res.prefill_ms / 1e3)
-                self.metrics.engine_decode_seconds.observe(res.decode_ms / 1e3)
+                exemplar = None
+                if r.span is not None:
+                    # Slab residency (admission to delivery, the pipeline's
+                    # depth-1 lag included): the summary span whose window
+                    # the engine.segment spans subdivide.
+                    r.span.child(
+                        "engine.decode",
+                        t0=slab.t_decode0[i],
+                        t1=t1,
+                        tokens=len(ids),
+                        row=i,
+                    )
+                    if self.config.tracing.exemplars and r.span.record.sampled:
+                        # Head-unsampled traces are (usually) never
+                        # retained: an exemplar naming one would 404 at
+                        # GET /traces/{id}. The error-tail exception can't
+                        # be known yet mid-flight; sampled is the honest
+                        # approximation the middleware's kept-gate refines.
+                        exemplar = {"trace_id": r.span.trace_id}
+                self.metrics.engine_decode_seconds.observe(
+                    res.decode_ms / 1e3, exemplar=exemplar
+                )
                 self._release_row(slab, i)
                 r.loop.call_soon_threadsafe(_resolve, r.future, res, None)
 

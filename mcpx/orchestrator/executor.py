@@ -33,6 +33,7 @@ from mcpx.core.dag import DagNode, Plan
 from mcpx.core.trace import ExecutionTrace, NodeAttempt
 from mcpx.orchestrator.transport import Transport, TransportError
 from mcpx.registry.base import RegistryBackend
+from mcpx.telemetry import tracing
 from mcpx.telemetry.metrics import Metrics
 from mcpx.telemetry.stats import TelemetryStore
 
@@ -87,7 +88,11 @@ class Orchestrator:
         for e in plan.edges:
             preds[e.dst].append(e.src)
 
-        with trace.span("execute"):
+        # Both trace systems record the walk: ExecutionTrace stays the wire
+        # artifact inside the /execute response; the tracing spine makes the
+        # same walk a subtree of the REQUEST's trace (node retries/fallbacks
+        # appear inline under the root span, not in a parallel format).
+        with trace.span("execute"), tracing.span("execute", nodes=len(plan.nodes)):
             for generation in plan.topological_generations():
                 runnable: list[DagNode] = []
                 for name in generation:
@@ -152,11 +157,27 @@ class Orchestrator:
     ) -> tuple[bool, Any]:
         nt = trace.node(node.name, node.service)
         nt.started_at = asyncio.get_event_loop().time()
+        with tracing.span(
+            f"node:{node.name}", service=node.service
+        ) as nsp:
+            ok, value = await self._attempt_chain(node, results, payload, nt, nsp)
+        return ok, value
 
+    async def _attempt_chain(
+        self,
+        node: DagNode,
+        results: dict[str, Any],
+        payload: dict[str, Any],
+        nt,
+        nsp,
+    ) -> tuple[bool, Any]:
         endpoint, fallbacks = await self._resolve_endpoints(node)
         if not endpoint:
             nt.status = "failed"
             nt.finished_at = asyncio.get_event_loop().time()
+            if nsp is not None:
+                nsp.status = "error"
+                nsp.set(error=f"no endpoint for service '{node.service}'")
             return False, f"no endpoint for service '{node.service}'"
 
         body = dict(node.params)
@@ -168,7 +189,9 @@ class Orchestrator:
 
         # Attempt chain: primary × (retries+1) with backoff, then each
         # fallback endpoint once, in declared order (reference README.md:49
-        # "ordered fallbacks", finally implemented).
+        # "ordered fallbacks", finally implemented). Each attempt is both a
+        # NodeAttempt (the /execute response artifact) and a child span
+        # under the node's span (the request trace), same timestamps.
         attempts: list[tuple[str, str]] = [("primary", endpoint)]
         attempts += [("retry", endpoint)] * node.retries
         attempts += [("fallback", fb) for fb in fallbacks]
@@ -183,16 +206,23 @@ class Orchestrator:
             try:
                 async with self._sem:
                     response = await self._transport.post(url, body, node.timeout_s)
-                latency_ms = (asyncio.get_event_loop().time() - t0) * 1e3
+                t1 = asyncio.get_event_loop().time()
+                latency_ms = (t1 - t0) * 1e3  # mcpx: ignore[span-across-await-blocking] - the attempt span right below IS the span; NodeAttempt needs the same number with tracing off
                 nt.attempts.append(
                     NodeAttempt(endpoint=url, kind=kind, status="ok", latency_ms=latency_ms)
                 )
                 self._record(node.service, latency_ms, ok=True)
+                self._record_attempt(kind, "ok")
+                if nsp is not None:
+                    nsp.child(
+                        "attempt", t0=t0, t1=t1, kind=kind, status="ok", endpoint=url
+                    )
                 nt.status = "ok"
                 nt.finished_at = asyncio.get_event_loop().time()
                 return True, response
             except TransportError as e:
-                latency_ms = (asyncio.get_event_loop().time() - t0) * 1e3
+                t1 = asyncio.get_event_loop().time()
+                latency_ms = (t1 - t0) * 1e3  # mcpx: ignore[span-across-await-blocking] - the attempt span right below IS the span; NodeAttempt needs the same number with tracing off
                 status = "timeout" if e.timeout else "error"
                 nt.attempts.append(
                     NodeAttempt(
@@ -201,10 +231,24 @@ class Orchestrator:
                     )
                 )
                 self._record(node.service, latency_ms, ok=False)
+                self._record_attempt(kind, status)
+                if nsp is not None:
+                    nsp.child(
+                        "attempt",
+                        t0=t0,
+                        t1=t1,
+                        kind=kind,
+                        status=status,
+                        endpoint=url,
+                        error=str(e),
+                    )
                 last_error = str(e)
 
         nt.status = "failed"
         nt.finished_at = asyncio.get_event_loop().time()
+        if nsp is not None:
+            nsp.status = "error"
+            nsp.set(error=last_error or "all attempts failed")
         return False, last_error or "all attempts failed"
 
     async def _resolve_endpoints(self, node: DagNode) -> tuple[str, list[str]]:
@@ -235,3 +279,9 @@ class Orchestrator:
             self._metrics.service_calls.labels(
                 service=service, status="ok" if ok else "error"
             ).inc()
+
+    def _record_attempt(self, kind: str, status: str) -> None:
+        """Per-attempt retry/fallback accounting the reference README
+        promises (README.md:49): mcpx_node_attempts_total{kind, status}."""
+        if self._metrics is not None:
+            self._metrics.node_attempts.labels(kind=kind, status=status).inc()

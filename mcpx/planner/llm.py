@@ -34,6 +34,7 @@ from mcpx.planner.base import PlanContext
 from mcpx.planner.grammar import PlanGrammar, build_plan_grammar
 from mcpx.planner.heuristic import HeuristicPlanner
 from mcpx.registry.base import ServiceRecord, stable_snapshot
+from mcpx.telemetry import tracing
 
 log = logging.getLogger("mcpx.planner.llm")
 
@@ -213,7 +214,15 @@ class LLMPlanner:
         by_name = {
             s.name: s for s in all_services if s.name not in context.exclude
         }
-        grammar = await self._grammar(context, version, all_services)
+        with tracing.span(
+            "planner.grammar", mode=self.config.constrain_names
+        ) as gsp:
+            grammar = await self._grammar(context, version, all_services)
+            if gsp is not None:
+                # shape_only = the build ladder bottomed out (engine serves
+                # its generic grammar); which grammar a decode ran under is
+                # attribution data for hetero-batching DFA slots.
+                gsp.set(shape_only=grammar is None, registry_version=version)
         # Tokenize the fixed header separately so its ids are IDENTICAL
         # across requests whatever follows (subword tokenizers are not
         # concatenation-safe at the boundary) — the engine then serves the
@@ -256,6 +265,9 @@ class LLMPlanner:
             n_pruned = self._normalize_dataflow(plan, by_name)
             plan.intent = intent
             plan.origin = "llm"
+            sp = tracing.current_span()
+            if sp is not None:
+                sp.set(decode_attempts=attempt + 1, repaired=repaired)
             if self.config.explain:
                 plan.explanation = self._explain(plan, attempt) + (
                     " [repaired: dangling/backward next-references pruned]"
@@ -271,6 +283,12 @@ class LLMPlanner:
             self.config.max_plan_retries + 1,
             last_problems[:3],
         )
+        sp = tracing.current_span()
+        if sp is not None:
+            sp.set(
+                decode_attempts=self.config.max_plan_retries + 1,
+                heuristic_fallback=True,
+            )
         plan = await self.fallback.plan(intent, context)
         if self.config.explain:
             plan.explanation = (

@@ -33,12 +33,21 @@ from mcpx.core.errors import PlannerError, RegistryError
 from mcpx.registry.base import ServiceRecord
 from mcpx.scheduler import ShedError
 from mcpx.server.control import ControlPlane
+from mcpx.telemetry import tracing
 
 log = logging.getLogger("mcpx.server")
 
 
-def _json_error(status: int, message: str, **extra: Any) -> web.Response:
-    return web.json_response({"error": message, **extra}, status=status)
+def _json_error(
+    status: int, message: str, *, headers: Any = None, **extra: Any
+) -> web.Response:
+    """Error envelope. Always carries the active trace id (satellite of the
+    tracing spine): a user-reported failure line is then greppable straight
+    to its trace via GET /traces/{id}."""
+    tid = tracing.current_trace_id()
+    if tid is not None and "trace_id" not in extra:
+        extra["trace_id"] = tid
+    return web.json_response({"error": message, **extra}, status=status, headers=headers)
 
 
 async def _body(request: web.Request) -> dict[str, Any]:
@@ -64,6 +73,12 @@ TRACE_ID_KEY = "mcpx_trace_id"
 # planning/execution paths; observability and CRUD stay always-available).
 _LIMITED = {"/plan", "/execute", "/plan_and_execute"}
 
+# Observability surfaces are never traced (by route template): a scraper
+# polling /metrics or an operator paging through /traces would otherwise
+# flush the ring with traces OF the observability itself — and `mcpx trace
+# dump`'s "newest trace" would be its own /traces listing.
+_UNTRACED = {"/metrics", "/traces", "/traces/{trace_id}", "/healthz", "/telemetry"}
+
 
 def build_app(cp: ControlPlane) -> web.Application:
     metrics = cp.metrics
@@ -72,53 +87,92 @@ def build_app(cp: ControlPlane) -> web.Application:
 
     @web.middleware
     async def observability(request: web.Request, handler) -> web.StreamResponse:
-        """Every request: trace ID, latency histogram, request counter,
+        """Every request: root tracing span (W3C ``traceparent`` in/out),
+        trace ID, latency histogram (+ exemplar trace id), request counter,
         admission control (429) and a hard request timeout (504)."""
         from mcpx.core.trace import new_trace_id
 
         # Label by route template, not raw path: bounded metric cardinality.
         resource = getattr(request.match_info.route, "resource", None)
         endpoint = resource.canonical if resource is not None else "unmatched"
-        trace_id = new_trace_id()
+        # Read per-request so a tracer can be attached/detached on a LIVE
+        # server (bench.py's latency-attribution phase does exactly that).
+        tracer = cp.tracer
+        root = (
+            tracer.start_request(
+                endpoint,
+                traceparent=request.headers.get("traceparent"),
+                method=request.method,
+            )
+            if endpoint not in _UNTRACED
+            else None
+        )
+        trace_id = root.record.trace_id if root is not None else new_trace_id()
         request[TRACE_ID_KEY] = trace_id
         t0 = time.monotonic()
         status = "error"
+        # HTTP status class for tail sampling: only SERVER faults (5xx /
+        # timeout) are always-kept — a bot scan of 404s or a stream of
+        # malformed 400s must not flush the ring of the rare 5xx/SLO
+        # traces keep_errors exists to preserve.
+        http_status = 500
         limited = request.path in _LIMITED
         try:
-            if limited and inflight["n"] >= server_cfg.max_concurrency:
-                status = "throttled"
-                return web.json_response(
-                    {"error": "server at max concurrency, retry later"}, status=429
-                )
-            if limited:
-                inflight["n"] += 1
-            try:
-                resp = await asyncio.wait_for(
-                    handler(request), timeout=server_cfg.request_timeout_s
-                )
-            except asyncio.TimeoutError:
-                status = "timeout"
-                return web.json_response(
-                    {"error": f"request exceeded {server_cfg.request_timeout_s}s"},
-                    status=504,
-                )
-            except web.HTTPException:
-                raise
-            except Exception as e:  # noqa: BLE001 - errors must be JSON, never HTML
-                status = "error"
-                log.exception("unhandled error on %s", endpoint)
-                return web.json_response(
-                    {"error": f"{type(e).__name__}: {e}"}, status=500
-                )
-            finally:
+            with tracing.activate(root):
+                if limited and inflight["n"] >= server_cfg.max_concurrency:
+                    status = "throttled"
+                    http_status = 429
+                    return _json_error(
+                        429, "server at max concurrency, retry later"
+                    )
                 if limited:
-                    inflight["n"] -= 1  # mcpx: ignore[async-shared-mutation] - balanced dec of the inc above; int ops don't yield, so no lost update on one loop
-            status = "ok" if resp.status < 400 else "error"
-            resp.headers["X-Trace-Id"] = trace_id
-            return resp
+                    inflight["n"] += 1
+                try:
+                    resp = await asyncio.wait_for(
+                        handler(request), timeout=server_cfg.request_timeout_s
+                    )
+                except asyncio.TimeoutError:
+                    status = "timeout"
+                    http_status = 504
+                    return _json_error(
+                        504, f"request exceeded {server_cfg.request_timeout_s}s"
+                    )
+                except web.HTTPException as he:
+                    status = "ok" if he.status < 400 else "error"
+                    http_status = he.status
+                    raise
+                except Exception as e:  # noqa: BLE001 - errors must be JSON, never HTML
+                    status = "error"
+                    http_status = 500
+                    log.exception("unhandled error on %s", endpoint)
+                    return _json_error(500, f"{type(e).__name__}: {e}")
+                finally:
+                    if limited:
+                        inflight["n"] -= 1  # mcpx: ignore[async-shared-mutation] - balanced dec of the inc above; int ops don't yield, so no lost update on one loop
+                status = "ok" if resp.status < 400 else "error"
+                http_status = resp.status
+                resp.headers["X-Trace-Id"] = trace_id
+                if root is not None:
+                    resp.headers["traceparent"] = tracing.format_traceparent(root)
+                return resp
         finally:
+            if root is not None:
+                root.set(status=status)
+            # Retention decided BEFORE the histogram observation so the
+            # exemplar only ever names a trace GET /traces/{id} can serve.
+            kept = tracer.finish(
+                root, error=status == "timeout" or http_status >= 500
+            )
             metrics.requests.labels(endpoint=endpoint, status=status).inc()
-            metrics.request_latency.labels(endpoint=endpoint).observe(time.monotonic() - t0)
+            exemplar = (
+                {"trace_id": trace_id}
+                if kept and cp.config.tracing.exemplars
+                else None
+            )
+            metrics.request_latency.labels(endpoint=endpoint).observe(
+                time.monotonic() - t0,  # mcpx: ignore[span-across-await-blocking] - the latency metric must exist when tracing is disabled or the trace unsampled
+                exemplar=exemplar,
+            )
 
     app = web.Application(client_max_size=16 * 1024 * 1024, middlewares=[observability])
     app[CONTROL_PLANE_KEY] = cp
@@ -137,17 +191,29 @@ def build_app(cp: ControlPlane) -> web.Application:
         slot = None
         if sched is not None:
             ctx = sched.context_from_headers(request.headers)
-            try:
-                slot = await sched.acquire(ctx)
-            except ShedError as e:
-                return web.json_response(
-                    {
-                        "error": f"admission refused: {e}",
-                        "retry_after_s": e.retry_after_s,
-                    },
-                    status=429,
-                    headers={"Retry-After": e.retry_after_header()},
-                )
+            with tracing.span(
+                "sched.acquire", tenant=ctx.tenant, weight=ctx.weight
+            ) as ssp:
+                try:
+                    slot = await sched.acquire(ctx)
+                except ShedError as e:
+                    # The shed verdict is trace data too: a 429'd caller's
+                    # trace must say WHICH gate refused (rate/queue/deadline).
+                    if ssp is not None:
+                        ssp.set(verdict=e.outcome, retry_after_s=e.retry_after_s)
+                    return _json_error(
+                        429,
+                        f"admission refused: {e}",
+                        retry_after_s=e.retry_after_s,
+                        headers={"Retry-After": e.retry_after_header()},
+                    )
+                if ssp is not None:
+                    # Queue wait + the degradation-ladder decision taken at
+                    # grant time (primary vs shortlist-planner tier).
+                    ssp.set(
+                        verdict="degraded" if slot.degraded else "admitted",
+                        queue_wait_ms=round(slot.queue_wait_s * 1e3, 3),
+                    )
         try:
             p, latency_ms = await cp.plan(
                 intent, degraded=slot.degraded if slot is not None else False
@@ -237,7 +303,39 @@ def build_app(cp: ControlPlane) -> web.Application:
 
     # --------------------------------------------------------- observability
     async def metrics_handler(request: web.Request) -> web.Response:
+        # OpenMetrics on request (Accept negotiation): the exposition that
+        # renders the exemplar trace ids the latency histograms carry —
+        # a latency spike links to a concrete GET /traces/{id} trace.
+        if "application/openmetrics-text" in request.headers.get("Accept", ""):
+            from prometheus_client.openmetrics.exposition import (
+                CONTENT_TYPE_LATEST as OPENMETRICS_CONTENT_TYPE,
+            )
+
+            return web.Response(
+                body=cp.metrics.render(openmetrics=True),
+                headers={"Content-Type": OPENMETRICS_CONTENT_TYPE},
+            )
         return web.Response(body=cp.metrics.render(), content_type="text/plain", charset="utf-8")
+
+    async def traces_handler(request: web.Request) -> web.Response:
+        """Retained trace summaries, newest first (ring-buffer contents:
+        head-sampled + always-kept error/SLO-breach traces)."""
+        return web.json_response(
+            {"traces": [r.summary() for r in cp.tracer.traces()]}
+        )
+
+    async def trace_get(request: web.Request) -> web.Response:
+        tid = request.match_info["trace_id"]
+        rec = cp.tracer.get(tid)
+        if rec is None:
+            return _json_error(
+                404, f"no trace '{tid}' (evicted, unsampled, or never existed)"
+            )
+        if request.query.get("format") == "chrome":
+            # Chrome trace-event JSON: loads directly in Perfetto /
+            # chrome://tracing (docs/observability.md; `mcpx trace dump`).
+            return web.json_response(rec.to_chrome())
+        return web.json_response(rec.to_dict())
 
     async def telemetry_handler(request: web.Request) -> web.Response:
         return web.json_response(
@@ -342,6 +440,8 @@ def build_app(cp: ControlPlane) -> web.Application:
     app.router.add_get("/services/{name}", get_service)
     app.router.add_delete("/services/{name}", delete_service)
     app.router.add_get("/metrics", metrics_handler)
+    app.router.add_get("/traces", traces_handler)
+    app.router.add_get("/traces/{trace_id}", trace_get)
     app.router.add_get("/telemetry", telemetry_handler)
     app.router.add_get("/healthz", healthz)
     app.router.add_post("/profile/start", profile_start)

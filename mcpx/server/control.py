@@ -22,6 +22,7 @@ from mcpx.orchestrator.executor import ExecuteResult, Orchestrator
 from mcpx.planner.base import PlanContext, Planner
 from mcpx.planner.heuristic import HeuristicPlanner
 from mcpx.registry.base import RegistryBackend
+from mcpx.telemetry import tracing
 from mcpx.telemetry.metrics import Metrics
 from mcpx.telemetry.replan import ReplanPolicy
 from mcpx.telemetry.stats import TelemetryStore
@@ -44,6 +45,7 @@ class ControlPlane:
         telemetry_mirror: Any = None,  # mcpx.telemetry.mirror.RedisTelemetryMirror
         redis_plan_cache: Any = None,  # mcpx.server.plan_cache.RedisPlanCache
         scheduler: Any = None,  # mcpx.scheduler.Scheduler (None = pass-through)
+        tracer: Any = None,  # mcpx.telemetry.tracing.Tracer (None = built from config)
     ) -> None:
         self.config = config or MCPXConfig()
         self.registry = registry
@@ -59,6 +61,14 @@ class ControlPlane:
         # by the /plan handler, so it can be attached/detached at runtime
         # (bench.py's overload phase enables it against a live server).
         self.scheduler = scheduler
+        # Request-tracing spine (mcpx/telemetry/tracing.py). Read per-request
+        # by the server middleware so it can be attached/detached on a live
+        # server (bench.py's attribution phase does exactly that).
+        if tracer is None:
+            from mcpx.telemetry.tracing import Tracer
+
+            tracer = Tracer(self.config.tracing)
+        self.tracer = tracer
         # Degradation target: the model-free shortlist planner — it still
         # plans over the retrieval shortlist via _context, so degraded
         # service is the "shortlist planner" tier, not a blind fallback.
@@ -97,47 +107,61 @@ class ControlPlane:
         plans are never WRITTEN to any cache tier (they would keep serving
         heuristic plans after the ladder recovers)."""
         t0 = time.monotonic()
-        version = await self.registry.version()
-        key = (intent, version)
-        local_tier = self.config.planner.plan_cache_size > 0
-        if use_cache and local_tier:
-            cached = self._plan_cache.get(key)
-            if cached is not None:
-                self._plan_cache.move_to_end(key)
-                self.metrics.plan_cache.labels(result="hit").inc()
-                return cached, (time.monotonic() - t0) * 1e3
-        if use_cache and self.redis_plan_cache is not None:
-            # Second tier: shared across replicas/restarts, independent of
-            # the local LRU (plan_cache_size=0 disables only the local
-            # tier); a hit here still warms the LRU when enabled.
-            shared = await self.redis_plan_cache.get(intent, version)
-            if shared is not None:
-                if local_tier:
-                    self._cache_put(key, shared)
-                self.metrics.plan_cache.labels(result="redis_hit").inc()
-                return shared, (time.monotonic() - t0) * 1e3
-        if use_cache and (local_tier or self.redis_plan_cache is not None):
-            self.metrics.plan_cache.labels(result="miss").inc()
+        with tracing.span(
+            "plan", path="degraded" if degraded else "primary"
+        ) as sp:
+            version = await self.registry.version()
+            key = (intent, version)
+            local_tier = self.config.planner.plan_cache_size > 0
+            if use_cache and local_tier:
+                cached = self._plan_cache.get(key)
+                if cached is not None:
+                    self._plan_cache.move_to_end(key)
+                    self.metrics.plan_cache.labels(result="hit").inc()
+                    if sp is not None:
+                        sp.set(cache="hit", origin=cached.origin)
+                    return cached, (time.monotonic() - t0) * 1e3  # mcpx: ignore[span-across-await-blocking] - latency_ms is a client response field, served with tracing off too
+            if use_cache and self.redis_plan_cache is not None:
+                # Second tier: shared across replicas/restarts, independent of
+                # the local LRU (plan_cache_size=0 disables only the local
+                # tier); a hit here still warms the LRU when enabled.
+                shared = await self.redis_plan_cache.get(intent, version)
+                if shared is not None:
+                    if local_tier:
+                        self._cache_put(key, shared)
+                    self.metrics.plan_cache.labels(result="redis_hit").inc()
+                    if sp is not None:
+                        sp.set(cache="redis_hit", origin=shared.origin)
+                    return shared, (time.monotonic() - t0) * 1e3  # mcpx: ignore[span-across-await-blocking] - latency_ms is a client response field, served with tracing off too
+            if use_cache and (local_tier or self.redis_plan_cache is not None):
+                self.metrics.plan_cache.labels(result="miss").inc()
+                if sp is not None:
+                    sp.set(cache="miss")
 
-        planner = self.degraded_planner if degraded else self.planner
-        context = await self._context(intent, version=version)
-        try:
-            plan = await planner.plan(intent, context)
-            self.metrics.plans.labels(
-                planner=type(planner).__name__,
-                origin=plan.origin or "unknown",
-                status="ok",
-            ).inc()
-        except Exception:
-            self.metrics.plans.labels(
-                planner=type(planner).__name__, origin="none", status="error"
-            ).inc()
-            raise
-        if use_cache and not degraded and self.config.planner.plan_cache_size > 0:
-            self._cache_put(key, plan)
-        if use_cache and not degraded and self.redis_plan_cache is not None:
-            self._redis_cache_write(intent, version, plan)
-        return plan, (time.monotonic() - t0) * 1e3
+            planner = self.degraded_planner if degraded else self.planner
+            if sp is not None:
+                sp.set(planner=type(planner).__name__)
+            with tracing.span("plan.context"):
+                context = await self._context(intent, version=version)
+            try:
+                plan = await planner.plan(intent, context)
+                self.metrics.plans.labels(
+                    planner=type(planner).__name__,
+                    origin=plan.origin or "unknown",
+                    status="ok",
+                ).inc()
+            except Exception:
+                self.metrics.plans.labels(
+                    planner=type(planner).__name__, origin="none", status="error"
+                ).inc()
+                raise
+            if sp is not None:
+                sp.set(origin=plan.origin or "unknown")
+            if use_cache and not degraded and self.config.planner.plan_cache_size > 0:
+                self._cache_put(key, plan)
+            if use_cache and not degraded and self.redis_plan_cache is not None:
+                self._redis_cache_write(intent, version, plan)
+            return plan, (time.monotonic() - t0) * 1e3  # mcpx: ignore[span-across-await-blocking] - latency_ms is a client response field, served with tracing off too
 
     def _redis_cache_write(self, intent: str, version: int, plan: Plan) -> None:
         """Fire-and-forget write to the shared tier: put() swallows its own

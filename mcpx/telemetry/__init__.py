@@ -1,4 +1,5 @@
 from mcpx.telemetry.stats import ServiceStats, TelemetryStore
 from mcpx.telemetry.metrics import Metrics
+from mcpx.telemetry.tracing import Span, TraceRecord, Tracer
 
-__all__ = ["ServiceStats", "TelemetryStore", "Metrics"]
+__all__ = ["ServiceStats", "TelemetryStore", "Metrics", "Span", "TraceRecord", "Tracer"]

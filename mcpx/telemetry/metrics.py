@@ -57,6 +57,15 @@ class Metrics:
         self.replans = Counter(
             "mcpx_replans_total", "Telemetry-triggered replans", registry=self.registry
         )
+        self.node_attempts = Counter(
+            "mcpx_node_attempts_total",
+            "Per-node execution attempts by kind (the reference README.md:49 "
+            "promises retry/fallback accounting; fed from the executor's "
+            "span/attempt records). kind: primary | retry | fallback; "
+            "status: ok | error | timeout",
+            ["kind", "status"],
+            registry=self.registry,
+        )
         self.plan_cache = Counter(
             "mcpx_plan_cache_total", "Plan cache lookups", ["result"], registry=self.registry
         )
@@ -216,5 +225,15 @@ class Metrics:
             registry=self.registry,
         )
 
-    def render(self) -> bytes:
+    def render(self, *, openmetrics: bool = False) -> bytes:
+        """Prometheus text exposition; ``openmetrics=True`` renders the
+        OpenMetrics format instead — the only exposition that includes the
+        exemplar trace ids attached to latency observations (the classic
+        text format silently drops them)."""
+        if openmetrics:
+            from prometheus_client.openmetrics.exposition import (
+                generate_latest as generate_openmetrics,
+            )
+
+            return generate_openmetrics(self.registry)
         return generate_latest(self.registry)

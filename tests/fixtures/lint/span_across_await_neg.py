@@ -1,0 +1,33 @@
+"""Fixture: none of these trigger span-across-await-blocking — the delta
+never spans a yield point, the code is sync, or it is deadline arithmetic
+(no variable holds a bare clock read that crosses an await)."""
+
+import asyncio
+import time
+
+
+async def delta_after_the_await(work):
+    await asyncio.sleep(0)
+    t0 = time.monotonic()
+    work()
+    return time.monotonic() - t0  # same-segment timing: nothing yields inside
+
+
+def sync_timer(work):
+    t0 = time.time()
+    work()
+    return time.time() - t0  # sync function: not request-path event-loop code
+
+
+async def deadline_pattern():
+    deadline = time.monotonic() + 5.0
+    await asyncio.sleep(0)
+    return deadline - time.monotonic()  # deadline arithmetic, not an interval
+
+
+async def clock_reread(work):
+    t0 = time.monotonic()
+    await asyncio.sleep(0)
+    t0 = time.monotonic()  # re-read after the await resets the interval
+    work()
+    return time.monotonic() - t0

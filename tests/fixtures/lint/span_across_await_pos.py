@@ -1,0 +1,27 @@
+"""Fixture: every delta here spans an await and must trigger
+span-across-await-blocking."""
+
+import asyncio
+import time
+
+
+async def wall_clock(session):
+    t0 = time.time()
+    await session.post("/plan")
+    return (time.time() - t0) * 1e3  # line 11: wall-clock delta across await
+
+
+async def monotonic_clock():
+    t0 = time.monotonic()
+    await asyncio.sleep(0)
+    dt = time.monotonic() - t0  # line 17: monotonic delta across await
+    return dt
+
+
+async def loop_clock(sem, transport):
+    t0 = asyncio.get_event_loop().time()
+    async with sem:
+        response = await transport.post("/x")
+    t1 = asyncio.get_event_loop().time()
+    latency_ms = (t1 - t0) * 1e3  # line 26: loop-clock delta across async with
+    return response, latency_ms

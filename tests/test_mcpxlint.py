@@ -119,6 +119,27 @@ def test_blank_lines_negative():
     assert hits("blank_lines_neg.py", "blank-lines") == []
 
 
+def test_span_across_await_positive():
+    # time.time / time.monotonic / asyncio loop-clock deltas, each spanning
+    # a yield point (await or async with).
+    assert hits("span_across_await_pos.py", "span-across-await-blocking") == [11, 17, 26]
+
+
+def test_span_across_await_negative():
+    assert hits("span_across_await_neg.py", "span-across-await-blocking") == []
+
+
+def test_span_across_await_exempts_benchmarks_by_path(tmp_path):
+    # Offline measurement harnesses time awaits as their PRODUCT: any
+    # 'benchmarks' path segment is exempt from the request-path rule.
+    bench_dir = tmp_path / "benchmarks"
+    bench_dir.mkdir()
+    src = (FIXTURES / "span_across_await_pos.py").read_text()
+    (bench_dir / "probe.py").write_text(src)
+    res = scan_paths([bench_dir], root=tmp_path, rules=["span-across-await-blocking"])
+    assert res.findings == []
+
+
 # -------------------------------------------------------------- suppressions
 def test_suppression_consumes_finding_and_dead_one_is_reported():
     res = scan_paths([FIXTURES / "suppressed.py"], root=REPO)
