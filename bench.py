@@ -57,6 +57,16 @@ Environment knobs:
                          shares in the output JSON (headline phases always
                          run tracing-disabled)
     MCPX_BENCH_TRACE_REQUESTS     attribution-phase request count (default 96)
+    MCPX_BENCH_CHAOS     0 skips the chaos resilience phase (default on):
+                         the orchestrator's transport wrapped in a seeded
+                         fault injector (flapping/erroring primaries,
+                         healthy fallbacks), the same /execute workload
+                         served with resilience OFF then ON — reports
+                         chaos_success_rate / chaos_success_rate_baseline /
+                         deadline_overrun_share (success = ok within the
+                         per-request deadline header)
+    MCPX_BENCH_CHAOS_REQUESTS     chaos-phase request count per mode (160)
+    MCPX_BENCH_CHAOS_DEADLINE_MS  chaos-phase per-request deadline (400)
     MCPX_BENCH_OVERLOAD_FACTOR    offered load as a multiple of measured
                                   throughput (default 4)
     MCPX_BENCH_OVERLOAD_REQUESTS  overload-phase request count (default 256)
@@ -783,6 +793,156 @@ async def _attribution_phase(cp, base: str, records, rng, rate: float) -> "dict 
     return _attribution_from_traces(recs)
 
 
+async def _chaos_phase(cp, base: str) -> "dict | None":
+    """Fault-domain resilience scenario (ISSUE 5 acceptance): wrap the live
+    orchestrator's transport in a seeded ChaosTransport (flapping primaries,
+    injected errors/timeouts, healthy-ish fallbacks) and serve the SAME
+    /execute workload twice — resilience OFF (pre-resilience executor:
+    plain retries + fallbacks) then ON (circuit breakers + deadline budget
+    + hedging) — under the same fault profile and seed. A request SUCCEEDS
+    when it returns status "ok" within its deadline; an arrival after the
+    deadline is an SLO miss whatever the body says. Engine-free (/execute
+    only), runs dead last, restores the transport in a finally. Skip with
+    MCPX_BENCH_CHAOS=0."""
+    if os.environ.get("MCPX_BENCH_CHAOS", "1") == "0":
+        return None
+    from aiohttp import ClientSession, TCPConnector
+
+    from mcpx.core.config import ResilienceConfig
+    from mcpx.resilience import Resilience
+    from mcpx.resilience.chaos import ChaosProfile, ChaosTransport
+
+    n = int(os.environ.get("MCPX_BENCH_CHAOS_REQUESTS", "160"))
+    deadline_ms = float(os.environ.get("MCPX_BENCH_CHAOS_DEADLINE_MS", "400"))
+    orch = cp.orchestrator
+    prev_transport = orch._transport
+    prev_resilience = orch._resilience
+    local = getattr(prev_transport, "local", None)
+    if local is None:
+        return None  # non-router transport: nowhere to host the fake services
+
+    async def healthy(payload):
+        return {"ok": True}
+
+    for name in ("chaos-a", "chaos-a-fb", "chaos-b", "chaos-b-fb"):
+        local.register(name, healthy)
+    # Primaries are badly degraded (one flapping hard-down on a cycle, both
+    # erroring/timing out), fallbacks nearly healthy — the fault geometry
+    # where breakers (stop dialing the dead primary), budget (stop burning
+    # the deadline on its timeouts) and hedging (duplicate the laggard)
+    # each earn their keep.
+    profile = ChaosProfile.from_dict(
+        {
+            "seed": 1234,
+            "endpoints": {
+                "local://chaos-a": {
+                    "error_rate": 0.2,
+                    "timeout_rate": 0.55,
+                    "latency_ms": 5,
+                    "flap_period_s": 4.0,
+                    "flap_down_s": 2.0,
+                },
+                "local://chaos-b": {
+                    "error_rate": 0.2,
+                    "timeout_rate": 0.5,
+                    "latency_ms": 5,
+                },
+                "local://chaos-*-fb": {"error_rate": 0.05, "latency_ms": 10},
+            },
+        }
+    )
+    graph = {
+        "nodes": [
+            {
+                "name": "a", "service": "chaos-a", "endpoint": "local://chaos-a",
+                "retries": 2, "timeout_s": 0.15,
+                "fallbacks": ["local://chaos-a-fb"],
+            },
+            {
+                "name": "b", "service": "chaos-b", "endpoint": "local://chaos-b",
+                "retries": 2, "timeout_s": 0.15,
+                "fallbacks": ["local://chaos-b-fb"], "inputs": {"x": "a"},
+            },
+        ],
+        "edges": [{"src": "a", "dst": "b"}],
+    }
+
+    async def run_round(resilient: bool) -> dict:
+        # Fresh ChaosTransport per round: same profile, same seed, flap
+        # phase restarted — both modes face the same fault stream.
+        orch._transport = ChaosTransport(prev_transport, profile)
+        orch._resilience = (
+            Resilience(
+                ResilienceConfig(enabled=True),
+                telemetry=cp.telemetry,
+                metrics=cp.metrics,
+            )
+            if resilient
+            else None
+        )
+        counts = {"ok_within": 0, "ok_late": 0, "failed": 0, "error": 0,
+                  "overrun": 0}
+        lat: list[float] = []
+        async with ClientSession(connector=TCPConnector(limit=0)) as session:
+            sem = asyncio.Semaphore(16)
+
+            async def one(i: int) -> None:
+                async with sem:
+                    t0 = time.monotonic()
+                    try:
+                        async with session.post(
+                            f"{base}/execute",
+                            json={"graph": graph, "payload": {}},
+                            headers={"X-MCPX-Deadline-Ms": str(deadline_ms)},
+                        ) as resp:
+                            body = await resp.json()
+                            status = body.get("status")
+                    except Exception:  # noqa: BLE001 - counted, not fatal
+                        counts["error"] += 1
+                        return
+                    ms = (time.monotonic() - t0) * 1e3
+                    lat.append(ms)
+                    if ms > deadline_ms:
+                        counts["overrun"] += 1
+                    if status == "ok":
+                        counts["ok_within" if ms <= deadline_ms else "ok_late"] += 1
+                    else:
+                        counts["failed"] += 1
+
+            await asyncio.gather(*(one(i) for i in range(n)))
+        lat.sort()
+        return {
+            "success_rate": round(counts["ok_within"] / max(1, n), 4),
+            "overrun_share": round(counts["overrun"] / max(1, n), 4),
+            "ok_share": round(
+                (counts["ok_within"] + counts["ok_late"]) / max(1, n), 4
+            ),
+            "p99_ms": round(lat[int(0.99 * (len(lat) - 1))], 1) if lat else None,
+            **counts,
+        }
+
+    try:
+        # Baseline (resilience OFF) first: its completions also warm the
+        # TelemetryStore EWMAs the ON round's hedge delays derive from.
+        baseline = await run_round(False)
+        resilient = await run_round(True)
+    finally:
+        orch._transport = prev_transport
+        orch._resilience = prev_resilience
+    return {
+        "requests": n,
+        "deadline_ms": deadline_ms,
+        "seed": profile.seed,
+        "resilient": resilient,
+        "baseline": baseline,
+        # The three acceptance numbers, spelled the way the driver greps.
+        "chaos_success_rate": resilient["success_rate"],
+        "chaos_success_rate_baseline": baseline["success_rate"],
+        "deadline_overrun_share": resilient["overrun_share"],
+        "deadline_overrun_share_baseline": baseline["overrun_share"],
+    }
+
+
 async def _run(model_size: str, n_requests: int, concurrency: int, n_services: int) -> dict:
     from aiohttp import ClientSession, TCPConnector
     from aiohttp.test_utils import TestServer
@@ -971,9 +1131,15 @@ async def _run(model_size: str, n_requests: int, concurrency: int, n_services: i
         mixed = await _mixed_phase(cp, overload)
 
         # ---- Phase 5: latency attribution (ISSUE 4) — a traced open-loop
-        # sample at the phase-2 rate; runs dead last because attaching the
-        # tracer is the one thing this phase does that others must not see.
+        # sample at the phase-2 rate; runs after every headline scrape
+        # because attaching the tracer is the one thing this phase does
+        # that others must not see.
         attribution = await _attribution_phase(cp, base, records, rng, rate)
+
+        # ---- Phase 6: chaos resilience (ISSUE 5) — dead last: it swaps the
+        # orchestrator's transport for a fault injector, which no other
+        # phase may ever see (restored in its own finally).
+        chaos = await _chaos_phase(cp, base)
 
     finally:
         # Teardown in a FINALLY: a cancelled run (MCPX_BENCH_RUN_TIMEOUT_S
@@ -1047,6 +1213,10 @@ async def _run(model_size: str, n_requests: int, concurrency: int, n_services: i
         # p50 request — BENCH_*.json explains regressions, not just
         # reports them.
         "latency_attribution": attribution,
+        # Chaos resilience scenario (None when skipped): /execute success
+        # rate and deadline-overrun share under the same seeded fault
+        # profile with resilience on vs off (mcpx/resilience/).
+        "chaos": chaos,
         "plan_quality": quality,
         "plans_per_sec": plans_per_sec,
         "p50_ms": statistics.median(open_sorted),
@@ -1343,6 +1513,20 @@ def main() -> None:
                 "overload": stats["overload"],
                 "mixed": stats["mixed"],
                 "latency_attribution": stats["latency_attribution"],
+                "chaos": stats["chaos"],
+                # Acceptance keys promoted to the top level (ISSUE 5): the
+                # same seeded fault profile served with resilience on vs off.
+                "chaos_success_rate": (
+                    stats["chaos"]["chaos_success_rate"] if stats["chaos"] else None
+                ),
+                "chaos_success_rate_baseline": (
+                    stats["chaos"]["chaos_success_rate_baseline"]
+                    if stats["chaos"] else None
+                ),
+                "deadline_overrun_share": (
+                    stats["chaos"]["deadline_overrun_share"]
+                    if stats["chaos"] else None
+                ),
                 "grammar_fallback": stats["grammar_fallback"],
                 "cache_hit_share": round(stats["cache_hit_share"], 4),
                 "unique_intents": stats["unique_intents"],

@@ -45,6 +45,10 @@ def cmd_serve(args: argparse.Namespace) -> int:
     cfg = _load_config(args)
     if args.port:
         cfg.server.port = args.port
+    if args.chaos:
+        # Chaos injection (docs/resilience.md): wrap the transport in the
+        # seeded fault injector described by the profile file.
+        cfg.resilience.chaos_profile = args.chaos
     cp = build_control_plane(cfg)
     app = build_app(cp)
     web.run_app(app, host=cfg.server.host, port=cfg.server.port)
@@ -257,6 +261,11 @@ def main(argv: list[str] | None = None) -> int:
     p_serve.add_argument(
         "--log-json", action="store_true",
         help="one JSON object per log line (trace_id/span_id fields included)",
+    )
+    p_serve.add_argument(
+        "--chaos", default="", metavar="PROFILE_JSON",
+        help="serve through a seeded fault-injecting transport described by "
+        "this chaos profile file (docs/resilience.md)",
     )
     p_serve.set_defaults(func=cmd_serve)
 

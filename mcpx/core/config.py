@@ -326,6 +326,63 @@ class SchedulerConfig:
 
 
 @dataclass
+class ResilienceConfig:
+    """Fault-domain resilience (mcpx/resilience/): per-endpoint circuit
+    breakers, request deadline-budget propagation, and hedged attempts —
+    consulted by the executor's attempt chain. Off by default: with
+    ``enabled=false`` the executor's attempt chain is byte-identical to the
+    pre-resilience pass-through (no breaker consults, no budget, no hedges;
+    the /execute deadline header is not even read)."""
+
+    enabled: bool = False
+    # --- circuit breakers (one state machine per endpoint URL) -----------
+    # Rolling outcome window per endpoint; the error-rate trip reads it.
+    breaker_window: int = 20
+    # Error-rate trip: >= this failure share over the window trips the
+    # breaker open — once at least breaker_min_samples outcomes are in.
+    breaker_error_threshold: float = 0.5
+    breaker_min_samples: int = 5
+    # Hard trip regardless of the window: this many consecutive failures.
+    breaker_consecutive_failures: int = 5
+    # How long an open breaker refuses traffic before probing (half-open).
+    breaker_open_s: float = 5.0
+    # Half-open: each arrival probes the endpoint with this probability;
+    # the rest keep falling back, so one recovering endpoint never takes a
+    # thundering herd of probes at once.
+    breaker_half_open_probe_p: float = 0.3
+    # --- deadline-budget propagation (/execute) --------------------------
+    # Header carrying the caller's deadline in ms (same name the scheduler
+    # uses for /plan). Parsed only while resilience is enabled.
+    deadline_header: str = "X-MCPX-Deadline-Ms"
+    # Budget assumed when /execute sends no header; <= 0 = no budget
+    # (attempts run on per-node timeouts alone, pre-resilience behavior).
+    default_execute_deadline_ms: float = 0.0
+    # An attempt is not worth dispatching with less than this left — the
+    # budget is declared exhausted instead (the node fails with a distinct
+    # deadline-budget error rather than overshooting the SLO).
+    min_attempt_s: float = 0.005
+    # --- hedged attempts -------------------------------------------------
+    hedge_enabled: bool = True
+    # Launch the speculative duplicate after hedge_latency_factor x the
+    # service's EWMA latency (TelemetryStore), floored by hedge_min_delay_s.
+    # No telemetry yet (fewer than hedge_min_calls observations) = no hedge:
+    # cold services never double their own traffic on a guess.
+    hedge_latency_factor: float = 2.0
+    hedge_min_delay_s: float = 0.02
+    hedge_min_calls: int = 3
+    # Hedge budget: speculative duplicates may never exceed this fraction
+    # of primary attempts — hedging is a tail-latency tool, not a traffic
+    # multiplier.
+    hedge_max_fraction: float = 0.1
+    # --- chaos injection -------------------------------------------------
+    # JSON fault profile (docs/resilience.md schema); when set the factory
+    # wraps the transport in a seeded ChaosTransport (`mcpx serve --chaos`).
+    # Independent of `enabled`, so the bench can measure the SAME fault
+    # profile with resilience on vs off.
+    chaos_profile: str = ""
+
+
+@dataclass
 class TracingConfig:
     """End-to-end request tracing (mcpx/telemetry/tracing.py): the span
     spine every request carries from HTTP ingress to response. Disabled is
@@ -352,6 +409,7 @@ class MCPXConfig:
     server: ServerConfig = field(default_factory=ServerConfig)
     tracing: TracingConfig = field(default_factory=TracingConfig)
     scheduler: SchedulerConfig = field(default_factory=SchedulerConfig)
+    resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     registry: RegistryConfig = field(default_factory=RegistryConfig)
     model: ModelConfig = field(default_factory=ModelConfig)
     engine: EngineConfig = field(default_factory=EngineConfig)
@@ -477,6 +535,25 @@ class MCPXConfig:
                 "scheduler thresholds must satisfy 0 < recover_threshold "
                 f"({s.recover_threshold}) < degrade_threshold ({s.degrade_threshold})"
             )
+        r = self.resilience
+        if r.breaker_window < 1:
+            problems.append("resilience.breaker_window must be >= 1")
+        if not 0.0 < r.breaker_error_threshold <= 1.0:
+            problems.append("resilience.breaker_error_threshold must be in (0, 1]")
+        if r.breaker_min_samples < 1:
+            problems.append("resilience.breaker_min_samples must be >= 1")
+        if r.breaker_consecutive_failures < 1:
+            problems.append("resilience.breaker_consecutive_failures must be >= 1")
+        if r.breaker_open_s <= 0:
+            problems.append("resilience.breaker_open_s must be > 0")
+        if not 0.0 < r.breaker_half_open_probe_p <= 1.0:
+            problems.append("resilience.breaker_half_open_probe_p must be in (0, 1]")
+        if r.min_attempt_s < 0:
+            problems.append("resilience.min_attempt_s must be >= 0")
+        if r.hedge_latency_factor <= 0:
+            problems.append("resilience.hedge_latency_factor must be > 0")
+        if not 0.0 <= r.hedge_max_fraction <= 1.0:
+            problems.append("resilience.hedge_max_fraction must be in [0, 1]")
         t = self.tracing
         if not 0.0 <= t.sample_rate <= 1.0:
             problems.append("tracing.sample_rate must be in [0, 1]")

@@ -25,9 +25,13 @@ def new_trace_id() -> str:
 @dataclass
 class NodeAttempt:
     endpoint: str
-    kind: str  # "primary" | "retry" | "fallback"
-    status: str  # "ok" | "error" | "timeout"
-    latency_ms: float
+    # "primary" | "retry" | "fallback" | "hedge" (speculative duplicate)
+    kind: str
+    # "ok" | "error" | "timeout", plus the resilience skip statuses:
+    # "open" (circuit breaker refused), "budget" (deadline budget could not
+    # afford it), "cancelled" (hedge race: the other attempt won).
+    status: str
+    latency_ms: float = 0.0
     error: str = ""
 
 

@@ -2914,6 +2914,7 @@ class InferenceEngine:
             self._allocator.free(pfx.sid)
         self._prefix_cache.clear()
         self._paged_kv = self._init_pools()
+        self.metrics.engine_resets.inc()
 
     def _fail_rows(self, slab: "_Slab", error: BaseException) -> None:
         # Device copies may be stale or deleted (donated into a failed

@@ -6,16 +6,29 @@ Replaces the reference's serial topological walk (reference
   - independent nodes in the same topological generation run concurrently
     under ``asyncio.gather`` (the reference is serial even for parallel
     branches, ``control_plane.py:104``);
-  - per-node retry budget with exponential backoff (``README.md:49`` promises
-    retries; the code has none — SURVEY.md §2.1 #10), then an *ordered*
-    fallback-endpoint chain (the reference's single edge-fallback lookup
-    crashes, bug B2 at ``control_plane.py:119``);
+  - per-node retry budget with full-jitter exponential backoff
+    (``README.md:49`` promises retries; the code has none — SURVEY.md §2.1
+    #10), then an *ordered* fallback-endpoint chain (the reference's single
+    edge-fallback lookup crashes, bug B2 at ``control_plane.py:119``);
+  - non-retryable 4xx statuses (everything but 408/429) skip the remaining
+    retries of the same endpoint — a deterministic rejection cannot succeed
+    on replay — and a 429's Retry-After is honored as the backoff floor;
   - ``errors`` records only *final* failures; per-attempt history lives in
     the structured trace (bug B4: the reference leaves a stale error after a
     fallback succeeds, ``control_plane.py:114,125``);
   - a failed node *skips* its dependents but never aborts the walk: the
     response reports partial results (bug B5: the reference raises 502
     mid-walk and discards everything, ``control_plane.py:130``).
+
+With a ``Resilience`` facade wired (mcpx/resilience/, docs/resilience.md)
+the attempt chain additionally consults per-endpoint circuit breakers (an
+open endpoint is skipped straight to the next fallback), draws every
+attempt timeout from the request's deadline budget (retries/backoffs the
+budget cannot afford are skipped as ``status="budget"`` attempts;
+exhaustion fails the node with a distinct error), and races tail-latency
+primaries against one hedged duplicate to a fallback endpoint (first
+success wins, loser cancelled). Resilience off = this module's pre-existing
+behavior, byte for byte.
 
 Input wiring preserves reference semantics (``control_plane.py:107``): each
 declared input key resolves from accumulated upstream ``results`` first, then
@@ -25,6 +38,7 @@ the request ``payload``.
 from __future__ import annotations
 
 import asyncio
+import random
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
@@ -63,22 +77,43 @@ class Orchestrator:
         registry: Optional[RegistryBackend] = None,
         telemetry: Optional[TelemetryStore] = None,
         metrics: Optional[Metrics] = None,
+        resilience: Any = None,  # mcpx.resilience.Resilience (None = pass-through)
+        rng: Optional[random.Random] = None,
     ) -> None:
         self._transport = transport
         self._cfg = config or OrchestratorConfig()
         self._registry = registry
         self._telemetry = telemetry
         self._metrics = metrics
+        self._resilience = resilience
+        # Injectable RNG: full-jitter backoff stays deterministic in tests.
+        self._rng = rng or random.Random()
         self._sem = asyncio.Semaphore(self._cfg.max_node_concurrency)
+
+    @property
+    def resilience(self) -> Any:
+        """The wired Resilience facade, or None (pass-through). Read by the
+        /execute handler to decide whether the deadline header is live."""
+        return self._resilience
 
     async def execute(
         self,
         plan: Plan,
         payload: dict[str, Any],
         trace: Optional[ExecutionTrace] = None,
+        *,
+        deadline_ms: Optional[float] = None,
     ) -> ExecuteResult:
         plan.validate()
         trace = trace or ExecutionTrace()
+        # Deadline-budget propagation: one monotonic budget per request,
+        # shared by every node's attempt chain. None unless resilience is
+        # wired AND a deadline applies (header or configured default).
+        budget = (
+            self._resilience.budget(deadline_ms)
+            if self._resilience is not None
+            else None
+        )
         results: dict[str, Any] = {}
         errors: dict[str, str] = {}
         failed: set[str] = set()  # failed or skipped node names
@@ -108,7 +143,10 @@ class Orchestrator:
                 if not runnable:
                     continue
                 outcomes = await asyncio.gather(
-                    *(self._run_node(node, results, payload, trace) for node in runnable)
+                    *(
+                        self._run_node(node, results, payload, trace, budget)
+                        for node in runnable
+                    )
                 )
                 for node, (ok, value) in zip(runnable, outcomes):
                     if ok:
@@ -133,6 +171,7 @@ class Orchestrator:
         results: dict[str, Any],
         payload: dict[str, Any],
         trace: ExecutionTrace,
+        budget: Any = None,
     ) -> tuple[bool, Any]:
         """Returns ``(True, response)`` or ``(False, final_error_message)``.
 
@@ -141,7 +180,7 @@ class Orchestrator:
         running and the partial-results contract holds.
         """
         try:
-            return await self._run_node_inner(node, results, payload, trace)
+            return await self._run_node_inner(node, results, payload, trace, budget)
         except Exception as e:  # mcpx: ignore[broad-except] - per-node isolation boundary; error lands in the result envelope, never swallowed
             nt = trace.node(node.name, node.service)
             nt.status = "failed"
@@ -154,13 +193,16 @@ class Orchestrator:
         results: dict[str, Any],
         payload: dict[str, Any],
         trace: ExecutionTrace,
+        budget: Any,
     ) -> tuple[bool, Any]:
         nt = trace.node(node.name, node.service)
         nt.started_at = asyncio.get_event_loop().time()
         with tracing.span(
             f"node:{node.name}", service=node.service
         ) as nsp:
-            ok, value = await self._attempt_chain(node, results, payload, nt, nsp)
+            ok, value = await self._attempt_chain(
+                node, results, payload, nt, nsp, budget
+            )
         return ok, value
 
     async def _attempt_chain(
@@ -170,11 +212,14 @@ class Orchestrator:
         payload: dict[str, Any],
         nt,
         nsp,
+        budget,
     ) -> tuple[bool, Any]:
+        res = self._resilience
+        loop = asyncio.get_event_loop()
         endpoint, fallbacks = await self._resolve_endpoints(node)
         if not endpoint:
             nt.status = "failed"
-            nt.finished_at = asyncio.get_event_loop().time()
+            nt.finished_at = loop.time()
             if nsp is not None:
                 nsp.status = "error"
                 nsp.set(error=f"no endpoint for service '{node.service}'")
@@ -196,60 +241,251 @@ class Orchestrator:
         attempts += [("retry", endpoint)] * node.retries
         attempts += [("fallback", fb) for fb in fallbacks]
 
+        def record(
+            url: str, kind: str, status: str, t0: float, t1: float, error: str = ""
+        ) -> None:
+            """One attempt outcome into every artifact: NodeAttempt (the
+            /execute response), telemetry EWMA + breaker window (real
+            outcomes only — skips and cancellations observed nothing),
+            attempt metrics, and the request-trace child span."""
+            latency_ms = (t1 - t0) * 1e3
+            nt.attempts.append(
+                NodeAttempt(
+                    endpoint=url, kind=kind, status=status, latency_ms=latency_ms,
+                    error=error,
+                )
+            )
+            if status in ("ok", "error", "timeout"):
+                self._record(node.service, latency_ms, ok=status == "ok")
+                if res is not None:
+                    res.breakers.record(url, status == "ok", service=node.service)
+            self._record_attempt(kind, status)
+            if nsp is not None:
+                extra = {"error": error} if error else {}
+                nsp.child(
+                    "attempt", t0=t0, t1=t1, kind=kind, status=status,
+                    endpoint=url, **extra,
+                )
+
         last_error = ""
         backoff = self._cfg.retry_backoff_s
-        for i, (kind, url) in enumerate(attempts):
-            if kind == "retry" and backoff > 0:
-                await asyncio.sleep(backoff)
+        retry_after_s: Optional[float] = None
+        no_retry = False  # a non-retryable 4xx condemned the primary endpoint
+        for kind, url in attempts:
+            if kind == "retry" and no_retry:
+                continue
+            # Circuit breaker consult: an open endpoint is skipped straight
+            # to the next attempt in the chain (usually the first fallback).
+            # A refused primary condemns its queued retries too — one "open"
+            # record per endpoint, not one per chain entry.
+            if res is not None and not res.breakers.allow(url, service=node.service):
+                now = loop.time()
+                record(url, kind, "open", now, now, error="circuit breaker open")
+                last_error = f"circuit breaker open for {url}"
+                if kind == "primary":
+                    no_retry = True
+                continue
+            if kind == "retry":
+                # Full jitter (uniform over [0, backoff]): synchronized
+                # failures must not produce synchronized retry storms. A
+                # 429's Retry-After floors the draw; a wait the deadline
+                # budget cannot afford (plus one minimum useful attempt)
+                # skips this retry instead of sleeping through the SLO.
+                delay = self._rng.uniform(0.0, backoff) if backoff > 0 else 0.0
                 backoff *= self._cfg.retry_backoff_multiplier
-            t0 = asyncio.get_event_loop().time()
+                if retry_after_s is not None:
+                    delay = max(delay, retry_after_s)
+                if budget is not None and not budget.affords(
+                    delay + res.config.min_attempt_s
+                ):
+                    now = loop.time()
+                    record(
+                        url, kind, "budget", now, now,
+                        error="skipped: deadline budget cannot afford the retry backoff",
+                    )
+                    last_error = budget.exhausted_error()
+                    continue
+                if delay > 0:
+                    await asyncio.sleep(delay)
+            retry_after_s = None
+            # Deadline budget: the attempt timeout is min(node timeout,
+            # remaining budget); with less than one minimum attempt left the
+            # node fails with the DISTINCT budget error instead of silently
+            # overshooting the request SLO.
+            timeout_s = node.timeout_s
+            if budget is not None:
+                remaining = budget.remaining_s()
+                if remaining < res.config.min_attempt_s:
+                    now = loop.time()
+                    record(url, kind, "budget", now, now, error=budget.exhausted_error())
+                    last_error = budget.exhausted_error()
+                    break
+                timeout_s = min(timeout_s, remaining)
+            # Hedge eligibility: primary attempt, resilience wired, the
+            # service has telemetry to derive a delay from, and a fallback
+            # endpoint whose breaker is not open exists to duplicate to.
+            hedge_url = None
+            hedge_delay = None
+            if res is not None and kind == "primary":
+                hedge_delay = res.hedge.delay_s(node.service)
+                res.hedge.note_primary()
+                if hedge_delay is not None and hedge_delay < timeout_s:
+                    hedge_url = next(
+                        (fb for fb in fallbacks if not res.breakers.is_open(fb)),
+                        None,
+                    )
             try:
-                async with self._sem:
-                    response = await self._transport.post(url, body, node.timeout_s)
-                t1 = asyncio.get_event_loop().time()
-                latency_ms = (t1 - t0) * 1e3  # mcpx: ignore[span-across-await-blocking] - the attempt span right below IS the span; NodeAttempt needs the same number with tracing off
-                nt.attempts.append(
-                    NodeAttempt(endpoint=url, kind=kind, status="ok", latency_ms=latency_ms)
-                )
-                self._record(node.service, latency_ms, ok=True)
-                self._record_attempt(kind, "ok")
-                if nsp is not None:
-                    nsp.child(
-                        "attempt", t0=t0, t1=t1, kind=kind, status="ok", endpoint=url
+                if hedge_url is not None:
+                    response = await self._race_hedge(
+                        url, hedge_url, body, timeout_s, hedge_delay, budget, record
                     )
-                nt.status = "ok"
-                nt.finished_at = asyncio.get_event_loop().time()
-                return True, response
+                else:
+                    t0 = loop.time()
+                    try:
+                        response = await self._post(url, body, timeout_s)
+                    except TransportError as e:
+                        record(
+                            url, kind, "timeout" if e.timeout else "error",
+                            t0, loop.time(), error=str(e),
+                        )
+                        raise
+                    record(url, kind, "ok", t0, loop.time())
             except TransportError as e:
-                t1 = asyncio.get_event_loop().time()
-                latency_ms = (t1 - t0) * 1e3  # mcpx: ignore[span-across-await-blocking] - the attempt span right below IS the span; NodeAttempt needs the same number with tracing off
-                status = "timeout" if e.timeout else "error"
-                nt.attempts.append(
-                    NodeAttempt(
-                        endpoint=url, kind=kind, status=status, latency_ms=latency_ms,
-                        error=str(e),
-                    )
-                )
-                self._record(node.service, latency_ms, ok=False)
-                self._record_attempt(kind, status)
-                if nsp is not None:
-                    nsp.child(
-                        "attempt",
-                        t0=t0,
-                        t1=t1,
-                        kind=kind,
-                        status=status,
-                        endpoint=url,
-                        error=str(e),
-                    )
                 last_error = str(e)
+                if kind in ("primary", "retry") and not e.retryable:
+                    # Deterministic 4xx rejection (not 408/429): replaying
+                    # the same request at the same endpoint cannot succeed —
+                    # skip the remaining retries, go straight to fallbacks.
+                    no_retry = True
+                if e.status == 429 and e.retry_after_s is not None:
+                    retry_after_s = e.retry_after_s
+                continue
+            nt.status = "ok"
+            nt.finished_at = loop.time()
+            return True, response
 
         nt.status = "failed"
-        nt.finished_at = asyncio.get_event_loop().time()
+        nt.finished_at = loop.time()
         if nsp is not None:
             nsp.status = "error"
             nsp.set(error=last_error or "all attempts failed")
         return False, last_error or "all attempts failed"
+
+    async def _post(self, url: str, body: dict[str, Any], timeout_s: float):
+        async with self._sem:
+            return await self._transport.post(url, body, timeout_s)
+
+    async def _race_hedge(
+        self,
+        url: str,
+        hedge_url: str,
+        body: dict[str, Any],
+        timeout_s: float,
+        hedge_delay: float,
+        budget,
+        record,
+    ) -> dict[str, Any]:
+        """Race the primary attempt against one delayed speculative
+        duplicate to a fallback endpoint. First SUCCESS wins; the loser is
+        cancelled (recorded as ``status="cancelled"``). The duplicate
+        launches only once ``hedge_delay`` elapses with the primary still in
+        flight AND the hedge budget grants it. Both legs failing raises the
+        primary's error (falling back to the hedge's) into the normal
+        attempt chain."""
+        res = self._resilience
+        loop = asyncio.get_event_loop()
+        flight: dict[asyncio.Task, tuple[str, str, float]] = {}
+
+        def launch(u: str, kind: str) -> asyncio.Task:
+            # Re-cap at LAUNCH time: the hedge starts hedge_delay into the
+            # attempt, and giving it the full pre-race timeout would let the
+            # node outlive the deadline by two capped attempts instead of
+            # the documented at-most-one.
+            to = timeout_s
+            if budget is not None:
+                to = min(to, max(res.config.min_attempt_s, budget.remaining_s()))
+            t = asyncio.ensure_future(self._post(u, body, to))
+            flight[t] = (u, kind, loop.time())
+            return t
+
+        primary_task = launch(url, "primary")
+        primary_t0 = flight[primary_task][2]
+        hedge_decided = False
+        primary_exc: Optional[TransportError] = None
+        last_exc: Optional[TransportError] = None
+        try:
+            while flight:
+                timeout = None
+                if not hedge_decided:
+                    timeout = max(0.0, hedge_delay - (loop.time() - primary_t0))
+                done, _ = await asyncio.wait(
+                    set(flight), timeout=timeout, return_when=asyncio.FIRST_COMPLETED
+                )
+                if not done:
+                    # Hedge delay elapsed with the primary still in flight:
+                    # launch the one duplicate, if the budgets allow.
+                    hedge_decided = True
+                    if budget is not None and not budget.affords(
+                        res.config.min_attempt_s
+                    ):
+                        continue
+                    if res.hedge.try_acquire():
+                        res.record_hedge("launched")
+                        launch(hedge_url, "hedge")
+                    else:
+                        res.record_hedge("denied")
+                    continue
+                for t in done:
+                    u, kind, t0 = flight.pop(t)
+                    exc = t.exception()
+                    t1 = loop.time()
+                    if exc is None:
+                        record(u, kind, "ok", t0, t1)
+                        if kind == "hedge":
+                            res.record_hedge("win")
+                        return t.result()
+                    if not isinstance(exc, TransportError):
+                        raise exc  # transport-layer bug: the node-isolation boundary reports it
+                    record(
+                        u, kind, "timeout" if exc.timeout else "error",
+                        t0, t1, error=str(exc),
+                    )
+                    if kind == "hedge":
+                        res.record_hedge("loss")
+                    else:
+                        primary_exc = exc
+                    last_exc = exc
+            raise primary_exc or last_exc or TransportError(
+                "hedged attempt produced no outcome"
+            )
+        finally:
+            t1 = loop.time()
+            for t, (u, kind, t0) in flight.items():
+                if t.done() and not t.cancelled():
+                    # A loser that COMPLETED in the same tick as the winner:
+                    # its outcome is real — feed the breaker window and
+                    # telemetry like any other attempt instead of
+                    # mislabeling it cancelled.
+                    exc2 = t.exception()
+                    if exc2 is None:
+                        record(u, kind, "ok", t0, t1)
+                    else:
+                        err_status = (
+                            "timeout"
+                            if isinstance(exc2, TransportError) and exc2.timeout
+                            else "error"
+                        )
+                        record(u, kind, err_status, t0, t1, error=str(exc2))
+                    if kind == "hedge":
+                        res.record_hedge("loss" if exc2 is not None else "cancelled")
+                    continue
+                t.cancel()
+                if kind == "hedge":
+                    res.record_hedge("cancelled")
+                record(
+                    u, kind, "cancelled", t0, t1,
+                    error="hedge race: the other attempt won",
+                )
 
     async def _resolve_endpoints(self, node: DagNode) -> tuple[str, list[str]]:
         """Endpoint resolution: the plan's endpoint if set, else the registry

@@ -25,11 +25,43 @@ from mcpx.core.errors import MCPXError
 LocalHandler = Callable[[dict[str, Any]], Awaitable[dict[str, Any]]]
 
 
+def _parse_retry_after(raw: Optional[str]) -> Optional[float]:
+    """Seconds form of the Retry-After header; the HTTP-date form (rare on
+    429s) is ignored rather than parsed — a backoff hint, not a contract."""
+    if not raw:
+        return None
+    try:
+        v = float(raw)
+    except ValueError:
+        return None
+    return v if v >= 0 else None
+
+
 class TransportError(MCPXError):
-    def __init__(self, message: str, *, timeout: bool = False, status: int = 0) -> None:
+    def __init__(
+        self,
+        message: str,
+        *,
+        timeout: bool = False,
+        status: int = 0,
+        retry_after_s: Optional[float] = None,
+    ) -> None:
         super().__init__(message)
         self.timeout = timeout
         self.status = status
+        # Surfaced from a 429/503 Retry-After header so the executor can
+        # honor it (capped against the request's remaining deadline budget).
+        self.retry_after_s = retry_after_s
+
+    @property
+    def retryable(self) -> bool:
+        """Whether retrying the SAME endpoint can plausibly succeed.
+        Timeouts and transport/5xx failures are; a 4xx is a deterministic
+        rejection of this request — except 408 (server-side timeout) and
+        429 (transient throttling)."""
+        if self.timeout or self.status == 0:
+            return True
+        return not (400 <= self.status < 500) or self.status in (408, 429)
 
 
 class Transport:
@@ -68,7 +100,11 @@ class AioHttpTransport(Transport):
                 if resp.status >= 400:
                     body = (await resp.text())[:512]
                     raise TransportError(
-                        f"HTTP {resp.status} from {url}: {body}", status=resp.status
+                        f"HTTP {resp.status} from {url}: {body}",
+                        status=resp.status,
+                        retry_after_s=_parse_retry_after(
+                            resp.headers.get("Retry-After")
+                        ),
                     )
                 try:
                     return await resp.json(content_type=None)

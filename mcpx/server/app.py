@@ -253,7 +253,20 @@ def build_app(cp: ControlPlane) -> web.Application:
             plan_obj = Plan.from_wire(graph)
         except PlanValidationError as e:
             return _json_error(422, "invalid graph", problems=e.problems)
-        result = await cp.execute(plan_obj, payload)
+        # Deadline-budget propagation (mcpx/resilience/): the deadline
+        # header becomes the request's attempt budget. Read per-request and
+        # only while resilience is wired — with ResilienceConfig disabled
+        # the header is not even parsed and this path is byte-identical to
+        # the pre-resilience pass-through.
+        deadline_ms = None
+        if cp.orchestrator.resilience is not None:
+            raw = request.headers.get(cp.config.resilience.deadline_header)
+            if raw:
+                try:
+                    deadline_ms = float(raw)
+                except ValueError:
+                    pass  # scheduling hints never 400 a valid graph
+        result = await cp.execute(plan_obj, payload, deadline_ms=deadline_ms)
         return web.json_response(result.to_dict())
 
     # ------------------------------------------------------ plan_and_execute

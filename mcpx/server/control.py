@@ -209,9 +209,19 @@ class ControlPlane:
 
     # --------------------------------------------------------------- execute
     async def execute(
-        self, plan: Plan, payload: dict[str, Any], trace: Optional[ExecutionTrace] = None
+        self,
+        plan: Plan,
+        payload: dict[str, Any],
+        trace: Optional[ExecutionTrace] = None,
+        *,
+        deadline_ms: Optional[float] = None,
     ) -> ExecuteResult:
-        return await self.orchestrator.execute(plan, payload, trace)
+        """``deadline_ms`` (the /execute deadline header, parsed by the
+        handler only while resilience is wired) becomes the request's
+        deadline budget inside the orchestrator's attempt chains."""
+        return await self.orchestrator.execute(
+            plan, payload, trace, deadline_ms=deadline_ms
+        )
 
     # ------------------------------------------------------- plan_and_execute
     async def plan_and_execute(self, intent: str, payload: dict[str, Any]) -> dict[str, Any]:

@@ -69,12 +69,30 @@ def build_control_plane(
             ttl_s=config.planner.plan_cache_redis_ttl_s,
         )
     metrics = Metrics()
+    if config.resilience.chaos_profile:
+        # Chaos injection (`mcpx serve --chaos profile.json`): every
+        # microservice call crosses the seeded fault injector. Wrapped
+        # OUTSIDE the resilience gate on purpose — the bench measures the
+        # same fault profile with resilience on vs off.
+        from mcpx.resilience.chaos import ChaosProfile, ChaosTransport
+
+        transport = ChaosTransport(
+            transport, ChaosProfile.from_file(config.resilience.chaos_profile)
+        )
+    resilience = None
+    if config.resilience.enabled:
+        from mcpx.resilience import Resilience
+
+        resilience = Resilience(
+            config.resilience, telemetry=telemetry, metrics=metrics
+        )
     orchestrator = Orchestrator(
         transport,
         config.orchestrator,
         registry=registry,
         telemetry=telemetry,
         metrics=metrics,
+        resilience=resilience,
     )
     if planner is None:
         if config.planner.kind == "heuristic":
@@ -111,7 +129,12 @@ def build_control_plane(
         telemetry=telemetry,
         metrics=metrics,
         retriever=retriever,
-        replan_policy=ReplanPolicy(config.telemetry),
+        replan_policy=ReplanPolicy(
+            config.telemetry,
+            # Breaker state feeds replan exclusions: a learned-down endpoint
+            # is routed around at PLAN time, not rediscovered per execute.
+            breakers=resilience.breakers if resilience is not None else None,
+        ),
         telemetry_mirror=telemetry_mirror,
         redis_plan_cache=redis_plan_cache,
         scheduler=scheduler,

@@ -61,9 +61,37 @@ class Metrics:
             "mcpx_node_attempts_total",
             "Per-node execution attempts by kind (the reference README.md:49 "
             "promises retry/fallback accounting; fed from the executor's "
-            "span/attempt records). kind: primary | retry | fallback; "
-            "status: ok | error | timeout",
+            "span/attempt records). kind: primary | retry | fallback | hedge; "
+            "status: ok | error | timeout | open (circuit breaker refused) | "
+            "budget (deadline budget could not afford it) | cancelled "
+            "(hedge race lost)",
             ["kind", "status"],
+            registry=self.registry,
+        )
+        # Resilience (mcpx/resilience/, docs/resilience.md): breaker state,
+        # breaker transitions and hedge accounting.
+        self.breaker_state = Gauge(
+            "mcpx_breaker_state",
+            "Worst (most open) circuit-breaker state across the service's "
+            "consulted endpoints — a healthy fallback never masks an open "
+            "primary: 0 closed, 1 half-open (probing), 2 open (refusing)",
+            ["service"],
+            registry=self.registry,
+        )
+        self.breaker_transitions = Counter(
+            "mcpx_breaker_transitions_total",
+            "Circuit-breaker state transitions, labeled by the state "
+            "ENTERED (open = a trip, closed = a recovery, half_open only "
+            "transitions on consult so it is not counted here)",
+            ["state"],
+            registry=self.registry,
+        )
+        self.hedges = Counter(
+            "mcpx_hedges_total",
+            "Hedged-attempt accounting. outcome: launched (duplicate "
+            "dispatched) | denied (hedge budget refused) | win (hedge beat "
+            "the primary) | loss (hedge failed) | cancelled (primary won)",
+            ["outcome"],
             registry=self.registry,
         )
         self.plan_cache = Counter(
@@ -110,6 +138,15 @@ class Metrics:
         self.admitted_rows = Counter(
             "mcpx_engine_admitted_rows_total",
             "Requests admitted into slab rows",
+            registry=self.registry,
+        )
+        self.engine_resets = Counter(
+            "mcpx_engine_resets_total",
+            "KV-pool resets after a failed dispatch (_reset_pools): every "
+            "resident row was failed and fresh zeroed pools restored "
+            "service — a nonzero rate means the engine is surviving "
+            "device/runtime faults, a growing one means it is drowning in "
+            "them",
             registry=self.registry,
         )
         self.reaped_rows = Counter(

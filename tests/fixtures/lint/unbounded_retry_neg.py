@@ -1,0 +1,55 @@
+"""Negative fixtures for unbounded-retry-loop: bounded retries, give-up
+paths, and non-transport awaits must not match."""
+import asyncio
+import time
+
+
+async def bounded_by_deadline(session):
+    deadline_at = time.monotonic() + 5.0
+    while True:
+        try:
+            return await session.post("http://svc/x", json={})
+        except ConnectionError:
+            if time.monotonic() > deadline_at:
+                raise
+            await asyncio.sleep(0.1)
+
+
+async def gives_up(transport, body):
+    for _ in range(5):
+        try:
+            return await transport.post("http://svc/x", body, 5.0)
+        except Exception:
+            raise
+
+
+async def budget_consult(client, budget):
+    while True:
+        try:
+            async with client.get("http://svc/health") as resp:
+                return resp.status
+        except OSError:
+            if not budget.affords(0.1):
+                return None
+            await asyncio.sleep(0.1)
+
+
+async def queue_poller_not_transport(q):
+    while True:
+        try:
+            return await q.get()
+        except Exception:
+            continue
+
+
+async def no_catch_just_loops(session):
+    while True:
+        await session.post("http://svc/x", json={})
+
+
+def sync_never_matches(session):
+    while True:
+        try:
+            return session.post("http://svc/x", json={})
+        except ConnectionError:
+            continue

@@ -1,0 +1,40 @@
+"""Positive fixtures for unbounded-retry-loop: retry loops around transport
+calls with no deadline or attempt bound."""
+import asyncio
+
+
+async def poll_forever(session):
+    while True:
+        try:
+            return await session.post("http://svc/x", json={})
+        except ConnectionError:
+            await asyncio.sleep(0.1)
+
+
+async def hammer(transport, body):
+    for _ in range(1000):
+        try:
+            await transport.post("http://svc/x", body, 5.0)
+        except Exception:
+            continue
+
+
+async def aiohttp_idiom(client):
+    while True:
+        try:
+            async with client.get("http://svc/health") as resp:
+                if resp.status == 200:
+                    return
+        except OSError:
+            await asyncio.sleep(0.5)
+
+
+async def outer(session):
+    async def inner(client):
+        while True:
+            try:
+                await client.post("http://svc/x", json={})
+            except Exception:
+                continue
+
+    await inner(session)

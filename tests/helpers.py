@@ -22,17 +22,27 @@ class FakeService:
         fail_times: int = 0,
         always_fail: bool = False,
         result: dict[str, Any] | None = None,
+        error_status: int = 0,
+        retry_after_s: float | None = None,
     ) -> None:
         self.name = name
         self.calls: list[dict[str, Any]] = []
         self._fail_times = fail_times
         self._always_fail = always_fail
         self._result = result
+        # Scripted failure shape: an HTTP status (e.g. 404, 429) and an
+        # optional Retry-After, for the executor's retryability logic.
+        self._error_status = error_status
+        self._retry_after_s = retry_after_s
 
     async def __call__(self, payload: dict[str, Any]) -> dict[str, Any]:
         self.calls.append(payload)
         if self._always_fail or len(self.calls) <= self._fail_times:
-            raise TransportError(f"{self.name} injected failure #{len(self.calls)}")
+            raise TransportError(
+                f"{self.name} injected failure #{len(self.calls)}",
+                status=self._error_status,
+                retry_after_s=self._retry_after_s,
+            )
         if self._result is not None:
             return self._result
         return {"service": self.name, "echo": payload}

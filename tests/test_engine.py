@@ -506,6 +506,7 @@ def test_mid_serving_failure_fails_rows_and_recovers():
                 raise RuntimeError("injected device failure")
 
             eng._jit_segment = boom
+            resets0 = eng.metrics.engine_resets._value.get()
             # The caller sees the ORIGINAL device error, not a wrapper.
             with pytest.raises(RuntimeError, match="injected device failure"):
                 await eng.generate(prompt, max_new_tokens=24)
@@ -513,6 +514,15 @@ def test_mid_serving_failure_fails_rows_and_recovers():
             assert not eng._inflight and not eng._pending_admissions
             assert eng._allocator.stats().sequences == 0
             eng._allocator.check_invariants()
+            # The recovery is observable: mcpx_engine_resets_total counts
+            # every _reset_pools a failed dispatch forced. Polled: the
+            # request future resolves inside _fail_rows, BEFORE the worker
+            # thread reaches _reset_pools.
+            for _ in range(200):
+                if eng.metrics.engine_resets._value.get() > resets0:
+                    break
+                await asyncio.sleep(0.01)
+            assert eng.metrics.engine_resets._value.get() > resets0
 
             # Restore the device path: service resumes with fresh pools.
             eng._jit_segment = real_segment

@@ -29,6 +29,7 @@ RULE_IDS = {
     "jit-static-branch",
     "broad-except",
     "blank-lines",
+    "unbounded-retry-loop",
 }
 
 
@@ -127,6 +128,21 @@ def test_span_across_await_positive():
 
 def test_span_across_await_negative():
     assert hits("span_across_await_neg.py", "span-across-await-blocking") == []
+
+
+def test_unbounded_retry_positive():
+    # while True + for-range retry loops that await a transport call and
+    # swallow its failure with no deadline or attempt bound (the aiohttp
+    # `async with session.get(...)` idiom counts as the awaited call). The
+    # loop in the nested async def reports ONCE, under its own function —
+    # never once per enclosing scope.
+    assert hits("unbounded_retry_pos.py", "unbounded-retry-loop") == [7, 15, 23, 34]
+
+
+def test_unbounded_retry_negative():
+    # deadline consults, give-up raises, bound-shaped branch conditions,
+    # non-transport awaits and sync loops must not match.
+    assert hits("unbounded_retry_neg.py", "unbounded-retry-loop") == []
 
 
 def test_span_across_await_exempts_benchmarks_by_path(tmp_path):
