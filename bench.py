@@ -67,15 +67,46 @@ Environment knobs:
                          per-request deadline header)
     MCPX_BENCH_CHAOS_REQUESTS     chaos-phase request count per mode (160)
     MCPX_BENCH_CHAOS_DEADLINE_MS  chaos-phase per-request deadline (400)
+    MCPX_BENCH_SPEC      0 skips the speculative-decoding phase (default
+                         on): the same mixed engine stream served twice at
+                         the same offered load — speculation OFF (a true
+                         per-token baseline: no drafter, DFA fast-forward
+                         disabled, one forward per token) then ON (the
+                         grammar-aware recurrent drafter + one batched
+                         [rows, K+1] verify) — on a DEDICATED single-device
+                         engine (1×1 mesh, serving geometry otherwise):
+                         speculation is a per-chip decode economics lever,
+                         and the CPU fallback's 8-way virtual mesh would
+                         bill its serialized-collective simulation overhead
+                         to the OFF→ON delta. Reports spec_decode_tok_s /
+                         spec_speedup (tokens-per-forward ON/OFF — the
+                         bandwidth-bound-decode speedup; wall-clock ratio
+                         reported as spec_wall_speedup) / spec_accept_rate
+                         (overall + per constrained/free row class) and
+                         checks greedy outputs byte-identical across modes
+    MCPX_BENCH_SPEC_REQUESTS      spec-phase request count per mode (192,
+                         served as 3 interleaved OFF/ON rounds; each mode
+                         reports its best round so co-tenant CPU bursts
+                         must poison a whole mode, not one window, to
+                         skew the speedup)
+    MCPX_BENCH_SPEC_K    draft window width k for the spec phase and (with
+                         MCPX_BENCH_SPEC_HEADLINE) the headline engine
+                         (default: EngineConfig.speculative.k)
+    MCPX_BENCH_SPEC_HEADLINE      1 = serve the HEADLINE phases with
+                         speculation on too (forces hetero_batch; default 0
+                         keeps the headline comparable to earlier rounds)
     MCPX_BENCH_OVERLOAD_FACTOR    offered load as a multiple of measured
                                   throughput (default 4)
     MCPX_BENCH_OVERLOAD_REQUESTS  overload-phase request count (default 256)
     MCPX_BENCH_SLO_MS    overload-phase SLO / per-request deadline (default 1000)
-    MCPX_BENCH_TICK / _DEPTH / _MINFREE / _WAIT / _SPEC / _DRAFT
+    MCPX_BENCH_TICK / _DEPTH / _MINFREE / _WAIT / _SPECULATE_K / _DRAFT
                          worker-loop levers (decode_steps_per_tick,
                          pipeline_depth, admit_min_free, admit_max_wait_s,
                          speculate_k, draft_mode) — bake the probe sweep's
-                         p50-optimal point into the headline run
+                         p50-optimal point into the headline run. (The
+                         fast-forward-width lever was MCPX_BENCH_SPEC
+                         before the speculative-decoding phase claimed
+                         that name.)
 """
 
 from __future__ import annotations
@@ -110,6 +141,29 @@ def _peak_flops_per_chip() -> float | None:
         if sub in kind:
             return peak
     return None
+
+
+def _measured_peak_flops() -> float:
+    """Achievable dense-matmul FLOPs/s of the default backend, MEASURED
+    (best of a few timed f32 matmuls after a compile warm-up) — the MFU
+    denominator on hardware with no datasheet entry (the CPU proxy). A
+    measured peak can never print a confidently-wrong datasheet fraction:
+    the reported number is 'share of what a dense matmul actually achieves
+    here', labeled via mfu_basis."""
+    import jax
+    import jax.numpy as jnp
+
+    n = 1024
+    a = jnp.ones((n, n), jnp.float32)
+    b = jnp.ones((n, n), jnp.float32)
+    f = jax.jit(lambda x, y: x @ y)
+    f(a, b).block_until_ready()  # compile outside the timed reps
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        f(a, b).block_until_ready()
+        best = min(best, time.perf_counter() - t0)
+    return 2.0 * n**3 / max(1e-9, best)
 
 
 class BenchGateError(RuntimeError):
@@ -255,7 +309,7 @@ def _build_config(model_size: str):
                         ("MCPX_BENCH_DEPTH", "pipeline_depth", int),
                         ("MCPX_BENCH_MINFREE", "admit_min_free", int),
                         ("MCPX_BENCH_WAIT", "admit_max_wait_s", float),
-                        ("MCPX_BENCH_SPEC", "speculate_k", int),
+                        ("MCPX_BENCH_SPECULATE_K", "speculate_k", int),
                         ("MCPX_BENCH_DRAFT", "draft_mode", str),
                     )
                     if env in os.environ
@@ -275,7 +329,24 @@ def _build_config(model_size: str):
                 # Headline-phase heterogeneous batching (the mixed phase
                 # flips the flag per mode regardless): default off so the
                 # headline numbers stay comparable to earlier rounds.
-                "hetero_batch": os.environ.get("MCPX_BENCH_HETERO", "0") == "1",
+                # MCPX_BENCH_SPEC_HEADLINE implies it — the grammar-aware
+                # drafter only runs in the heterogeneous slab.
+                "hetero_batch": (
+                    os.environ.get("MCPX_BENCH_HETERO", "0") == "1"
+                    or os.environ.get("MCPX_BENCH_SPEC_HEADLINE", "0") == "1"
+                ),
+                # Headline-phase speculative decoding (the spec phase flips
+                # it per mode regardless): default off, same comparability
+                # argument.
+                "speculative": {
+                    "enabled": os.environ.get("MCPX_BENCH_SPEC_HEADLINE", "0")
+                    == "1",
+                    **(
+                        {"k": int(os.environ["MCPX_BENCH_SPEC_K"])}
+                        if "MCPX_BENCH_SPEC_K" in os.environ
+                        else {}
+                    ),
+                },
                 # Compile every (A, T) bucket before serving: the timed
                 # region must contain zero XLA compiles. MCPX_BENCH_WARMUP=0
                 # skips it for CPU smoke runs (a virtual-CPU fallback pays
@@ -702,6 +773,289 @@ async def _mixed_phase(cp, overload: "dict | None") -> "dict | None":
     }
 
 
+async def _spec_phase(cp) -> "dict | None":
+    """Grammar-aware speculative decoding scenario (ISSUE 6 acceptance):
+    offer the ENGINE the same mixed stream twice at the same offered load —
+
+      - **off**: a true per-token baseline. ``speculative.enabled=false``
+        AND ``speculate_k=1``, so DFA fast-forward is disabled too: every
+        emitted token costs one full model forward (the per-token host/
+        device loop speculation exists to kill — also the bug class the
+        ``per-token-host-loop`` lint rule polices on the host side). The
+        fast-forward (``speculate_k``, default 8) is deliberately OFF in
+        the baseline because it is itself a grammar-only speculation
+        mechanism — leaving it on would measure speculation against
+        speculation; the ``speculative.draft="grammar"`` ablation is the
+        in-design-space equivalent of that comparison.
+      - **on**: the recurrent drafter + grammar pre-filter + one batched
+        ``[rows, K+1]`` verify (``EngineConfig.speculative``).
+
+    Both modes serve a DEDICATED single-device engine (explicit 1×1 mesh,
+    same model/vocab/page geometry as the serving engine, hetero slab on):
+    speculation changes PER-CHIP decode economics — tokens per forward on
+    one accelerator — and that is what this phase isolates. On the
+    CPU-fallback platform the serving engine's 8-way *virtual* mesh
+    serializes every shard and collective onto the same host cores, a
+    simulation artifact whose per-forward cost no real single-chip (or
+    per-chip TPU) deployment pays; measuring the OFF→ON delta under it
+    would attribute fake collective overhead to speculation. Direct
+    ``engine.generate`` calls like the mixed phase (this measures the
+    decode loop, not HTTP); each mode gets an untimed warm round so no XLA
+    compile lands in its timed region, the two modes are timed in
+    interleaved rounds so a co-tenant CPU burst cannot land entirely
+    inside one mode's window, and the serving engine sits idle throughout
+    (the shared metrics registry deltas are the spec engine's alone).
+    Reports per-mode ``decode_tok_s``/``tok_per_forward``; the headline
+    ``spec_speedup`` is the ON/OFF **tokens-per-forward ratio** (on
+    bandwidth-bound accelerator decode a [rows, K+1] window streams the
+    weights once, so tokens-per-forward IS the wall speedup — the CPU
+    proxy's FLOP-bound forward cost and co-tenant core availability make
+    its wall clock a measure of the neighbours; that ratio is still
+    reported as ``spec_wall_speedup``); plus the accept rate overall and
+    split by constrained-vs-free row class (scraped from
+    ``mcpx_engine_spec_{drafted,accepted}_total``), and verifies the
+    deterministic (greedy) rows' outputs are byte-identical across modes —
+    speculation must be a pure perf lever, never a quality one (a parity
+    break fails the bench). Skip with MCPX_BENCH_SPEC=0."""
+    raw_gate = os.environ.get("MCPX_BENCH_SPEC", "1")
+    if raw_gate not in ("0", "1"):
+        # This name used to be the fast-forward-width lever (now
+        # MCPX_BENCH_SPECULATE_K): a leftover numeric value from an old
+        # harness would silently lose its tuning AND silently enable this
+        # phase — say so instead.
+        print(
+            f"bench: MCPX_BENCH_SPEC={raw_gate!r} is now the spec-phase "
+            "on/off gate (0|1); the speculate_k lever moved to "
+            "MCPX_BENCH_SPECULATE_K",
+            file=sys.stderr,
+        )
+    if raw_gate == "0":
+        return None
+    serving = getattr(cp.planner, "engine", None)
+    if serving is None or serving.state != "ready":
+        return None
+    from mcpx.core.config import MCPXConfig
+    from mcpx.engine.engine import InferenceEngine
+    from mcpx.planner.grammar import build_plan_grammar
+
+    n = max(1, int(os.environ.get("MCPX_BENCH_SPEC_REQUESTS", "192")))
+    hot = float(os.environ.get("MCPX_BENCH_MIXED_TEMPERATURE", "0.7"))
+    spec_dict = serving.config.to_dict()
+    spec_dict["engine"]["data_axis"] = 1
+    spec_dict["engine"]["model_axis"] = 1
+    spec_dict["engine"]["hetero_batch"] = True
+    spec_dict["engine"]["warmup_compile"] = False
+    # Eager admission: a speculated row retires in a handful of windows, so
+    # the default small-cohort rate limit leaves the slab half-empty
+    # between admit waves (measured: ON-mode occupancy 0.5 vs 0.88 OFF) —
+    # a scheduling artifact that would be billed to speculation. Applies
+    # to both modes equally.
+    spec_dict["engine"]["admit_min_free"] = 1
+    spec_dict["engine"]["admit_max_wait_s"] = 0.0
+    engine = InferenceEngine(MCPXConfig.from_dict(spec_dict), metrics=cp.metrics)
+    await engine.start()
+    tok = engine.tokenizer
+    ecfg = engine.config.engine
+    concurrency = min(2 * ecfg.max_batch_size, 64)
+    # Full-size plans (BPE teacher plans run ~43 tokens, p99 53 — see
+    # _build_config): a clipped 24-token budget retires rows so fast the
+    # slab drains between admissions, and the phase should be decode-
+    # dominated anyway.
+    budget = max(8, min(48, ecfg.max_decode_len))
+    g_alt = build_plan_grammar(
+        tok, ["spec-rank-svc", "spec-sum-svc", "spec-etl-svc"]
+    )
+    # The serving mix: greedy /plan (the common case speculation targets),
+    # a second grammar, free-form greedy, and two hot rows so stochastic
+    # accept rules run in the same slab.
+    classes = [
+        (True, 0.0, None),
+        (True, 0.0, g_alt),
+        (False, 0.0, None),
+        (True, hot, None),
+        (False, hot, None),
+    ]
+    deterministic = {i for i, c in enumerate(classes) if c[1] <= 0.0}
+
+    async def _idle() -> None:
+        while engine._slab.n_active or engine._queue.qsize():
+            await asyncio.sleep(0.05)
+        await asyncio.sleep(0.1)
+
+    async def one(i: int, sem: asyncio.Semaphore, sink: "dict | None") -> None:
+        constrained, temp, grammar = classes[i % len(classes)]
+        prompt = tok.encode(f"spec intent {i}: compose the services. JSON:")
+        async with sem:
+            r = await engine.generate(
+                prompt,
+                max_new_tokens=budget,
+                constrained=constrained,
+                temperature=temp,
+                grammar=grammar,
+            )
+        if sink is not None and (i % len(classes)) in deterministic:
+            sink[i] = r.token_ids
+
+    def _rate(prom1, prom0, cls):
+        dr = prom1.get(
+            f'mcpx_engine_spec_drafted_total{{cls="{cls}"}}', 0.0
+        ) - prom0.get(f'mcpx_engine_spec_drafted_total{{cls="{cls}"}}', 0.0)
+        ac = prom1.get(
+            f'mcpx_engine_spec_accepted_total{{cls="{cls}"}}', 0.0
+        ) - prom0.get(f'mcpx_engine_spec_accepted_total{{cls="{cls}"}}', 0.0)
+        return dr, ac
+
+    # OFF and ON are timed in INTERLEAVED rounds, not one solid block per
+    # mode, and each mode reports its BEST round: on a small shared-core
+    # host a co-tenant burst that lands inside one mode's only timed
+    # window can swing the ratio by 3x+ in either direction (measured —
+    # and contention hits the modes asymmetrically: ON's [rows, K+1]
+    # verify forwards are compute-heavy where OFF is dispatch-overhead-
+    # bound). External load only ever SLOWS a round, so the per-mode best
+    # round estimates each mode's uncontended rate; a burst now has to
+    # poison every round of a mode, not one block, to skew the headline.
+    # Counters (tokens/forwards/accepts) still total across rounds.
+    ROUNDS = 3
+    # Every timed chunk offers its whole request set at once, and the
+    # closed-loop concurrency never exceeds the chunk: slab occupancy —
+    # which the ON mode's per-row verify window amortises over — is then
+    # identical across rounds and modes instead of degrading when a chunk
+    # is smaller than the semaphore.
+    chunk_n = max(1, n // ROUNDS)
+    concurrency = min(concurrency, chunk_n)
+    acc = {
+        m: {"tok": 0.0, "fwd": 0.0, "elapsed": 0.0, "spec": [0.0] * 4,
+            "rounds": []}
+        for m in (False, True)
+    }
+    sinks: dict = {False: {}, True: {}}
+    warmed = {False: False, True: False}
+    prev_speculate_k = ecfg.speculate_k
+
+    async def set_mode(spec_on: bool) -> None:
+        await _idle()  # the spec latch flips only on an empty slab
+        ecfg.speculative.enabled = spec_on
+        ecfg.speculate_k = prev_speculate_k if spec_on else 1
+        if not warmed[spec_on]:  # keep each mode's XLA compile untimed
+            n_warm = max(len(classes), concurrency)
+            warm_sem = asyncio.Semaphore(concurrency)
+            # Warm ids DISJOINT from the timed ranges: warm requests must
+            # not pre-build any per-prompt engine state (prefixes, pages)
+            # a timed round then reuses.
+            await asyncio.gather(
+                *(one(1_000_000 + i, warm_sem, None) for i in range(n_warm))
+            )
+            await _idle()
+            warmed[spec_on] = True
+
+    try:
+        for r in range(ROUNDS):
+            lo, hi = r * n // ROUNDS, (r + 1) * n // ROUNDS
+            if lo >= hi:
+                continue
+            for spec_on in (False, True):
+                await set_mode(spec_on)
+                prom0 = _parse_prom(cp.metrics.render().decode())
+                sem = asyncio.Semaphore(concurrency)
+                t0 = time.monotonic()
+                await asyncio.gather(
+                    *(one(i, sem, sinks[spec_on]) for i in range(lo, hi))
+                )
+                elapsed = time.monotonic() - t0
+                prom1 = _parse_prom(cp.metrics.render().decode())
+                a = acc[spec_on]
+                r_tok = prom1.get(
+                    "mcpx_engine_decode_tokens_total", 0.0
+                ) - prom0.get("mcpx_engine_decode_tokens_total", 0.0)
+                a["tok"] += r_tok
+                a["fwd"] += prom1.get(
+                    "mcpx_engine_decode_forwards_total", 0.0
+                ) - prom0.get("mcpx_engine_decode_forwards_total", 0.0)
+                a["elapsed"] += elapsed
+                a["rounds"].append(
+                    {
+                        "decode_tok_s": round(r_tok / max(1e-9, elapsed), 1),
+                        "plans_per_sec": round(
+                            (hi - lo) / max(1e-9, elapsed), 2
+                        ),
+                    }
+                )
+                if spec_on:
+                    dr_c, ac_c = _rate(prom1, prom0, "constrained")
+                    dr_f, ac_f = _rate(prom1, prom0, "free")
+                    a["spec"] = [
+                        x + y for x, y in zip(a["spec"], (dr_c, ac_c, dr_f, ac_f))
+                    ]
+    finally:
+        await engine.aclose()
+
+    def mode_res(spec_on: bool) -> dict:
+        a = acc[spec_on]
+        res = {
+            "decode_tok_s": max(r["decode_tok_s"] for r in a["rounds"]),
+            "tok_per_forward": round(a["tok"] / max(1.0, a["fwd"]), 2),
+            "plans_per_sec": max(r["plans_per_sec"] for r in a["rounds"]),
+            "rounds": a["rounds"],
+        }
+        if spec_on:
+            dr_c, ac_c, dr_f, ac_f = a["spec"]
+            res["accept_rate"] = {
+                "overall": round((ac_c + ac_f) / max(1.0, dr_c + dr_f), 4),
+                "constrained": round(ac_c / max(1.0, dr_c), 4),
+                "free": round(ac_f / max(1.0, dr_f), 4),
+                "drafted": int(dr_c + dr_f),
+                "accepted": int(ac_c + ac_f),
+            }
+        return res
+
+    off, on = mode_res(False), mode_res(True)
+    out_off, out_on = sinks[False], sinks[True]
+    # Byte-identical greedy outputs across modes: the phase's own honesty
+    # gate — a "speedup" that changes what greedy rows emit is a bug, not
+    # a win (the same invariant tests/test_speculative.py pins), so it
+    # FAILS the bench like every other honesty gate rather than burying a
+    # false flag under a passing headline.
+    broken = [i for i in out_off if out_on.get(i) != out_off[i]]
+    if broken:
+        raise BenchGateError(
+            f"speculation changed greedy outputs on {len(broken)}/"
+            f"{len(out_off)} deterministic rows (spec-on vs spec-off)"
+        )
+    return {
+        "requests": n,
+        "concurrency": concurrency,
+        "k": ecfg.speculative.k,
+        "draft": ecfg.speculative.draft,
+        # The baseline is one-forward-per-token: speculate_k fast-forward
+        # (itself grammar-only speculation) is disabled in OFF, not just
+        # the drafter — see the phase docstring.
+        "off_basis": "per_token",
+        "off": off,
+        "on": on,
+        "spec_decode_tok_s": on["decode_tok_s"],
+        # The headline speedup is the FORWARD-AMORTISATION ratio — decode
+        # tokens per model forward, ON over OFF. On accelerator decode the
+        # forward is HBM-bandwidth-bound: a [rows, K+1] verify window
+        # streams the weights exactly once, so a window forward costs what
+        # a single-token forward costs and tokens-per-forward IS the
+        # wall-clock decode speedup. The CPU proxy's forward is FLOP-bound
+        # instead (a W-wide window really does ~W× the arithmetic) AND its
+        # wall clock moves 3x+ with co-tenant core availability (measured:
+        # identical code, 0.9-3.5 wall ratios across a day) — gating on it
+        # would measure the neighbours, not the subsystem. The wall-clock
+        # ratio is still reported right below, flagged by basis.
+        "spec_speedup": round(
+            on["tok_per_forward"] / max(1e-9, off["tok_per_forward"]), 3
+        ),
+        "spec_speedup_basis": "tok_per_forward",
+        "spec_wall_speedup": round(
+            on["decode_tok_s"] / max(1e-9, off["decode_tok_s"]), 3
+        ),
+        "spec_accept_rate": on.get("accept_rate"),
+        "greedy_parity": True,  # gated above: a parity break raised
+    }
+
+
 # Span names -> attribution phase keys (tracing spine, mcpx/telemetry/
 # tracing.py). Per request: scheduler queue wait, engine admit-wait
 # (enqueue -> admission prefill start), cohort prefill, slab-resident
@@ -1125,10 +1479,15 @@ async def _run(model_size: str, n_requests: int, concurrency: int, n_services: i
         # headline scrape so attaching the scheduler cannot perturb them.
         overload = await _overload_phase(cp, base, records, rng, plans_per_sec)
 
-        # ---- Phase 4: heterogeneous mixed-traffic (ISSUE 3) — last of the
-        # perf phases, so flipping hetero_batch on the live engine can't
-        # touch any earlier number.
+        # ---- Phase 4: heterogeneous mixed-traffic (ISSUE 3) — after every
+        # headline scrape, so flipping hetero_batch on the live engine
+        # can't touch any earlier number.
         mixed = await _mixed_phase(cp, overload)
+
+        # ---- Phase 7: grammar-aware speculative decoding (ISSUE 6) —
+        # right after the mixed phase (same flag-flipping discipline, same
+        # direct-engine measurement style; numbered 7 by birth order).
+        spec = await _spec_phase(cp)
 
         # ---- Phase 5: latency attribution (ISSUE 4) — a traced open-loop
         # sample at the phase-2 rate; runs after every headline scrape
@@ -1179,9 +1538,24 @@ async def _run(model_size: str, n_requests: int, concurrency: int, n_services: i
     decode_tokens = delta("mcpx_engine_decode_tokens_total")
     decode_forwards = delta("mcpx_engine_decode_forwards_total")
     prefill_tokens = delta("mcpx_engine_prefill_tokens_total")
-    n_params = getattr(engine, "model_cfg", None)
-    n_params = n_params.n_params if n_params is not None else 0
-    goodput_flops = 2.0 * n_params * (prefill_tokens + decode_tokens) / max(1e-9, elapsed)
+    model_cfg = getattr(engine, "model_cfg", None)
+    n_params = model_cfg.n_params if model_cfg is not None else 0
+    # Analytic goodput-FLOPs model: 2 · params per token processed
+    # (prefill + decode), PLUS the speculative drafter's scoring matmuls
+    # when the headline served with speculation on (2·D·V per drafted
+    # token — drafter_flops_per_token) so a speculated run bills its
+    # drafter honestly instead of flattering MFU with free proposals.
+    drafted_hdr = delta('mcpx_engine_spec_drafted_total{cls="constrained"}') + delta(
+        'mcpx_engine_spec_drafted_total{cls="free"}'
+    )
+    model_flops = 2.0 * n_params * (prefill_tokens + decode_tokens)
+    if drafted_hdr and model_cfg is not None:
+        from mcpx.engine.speculative import drafter_flops_per_token
+
+        model_flops += drafted_hdr * drafter_flops_per_token(
+            model_cfg.d_model, engine.tokenizer.vocab_size
+        )
+    goodput_flops = model_flops / max(1e-9, elapsed)
     peak = _peak_flops_per_chip() if _on_tpu() else None
     if peak is not None:
         import jax
@@ -1190,8 +1564,17 @@ async def _run(model_size: str, n_requests: int, concurrency: int, n_services: i
         # peak is per-chip x chips actually meshed.
         n_chips = engine._mesh.devices.size if engine is not None and engine._mesh is not None else len(jax.devices())
         mfu = goodput_flops / (peak * n_chips)
+        mfu_basis = "datasheet"
     else:
-        mfu = None
+        # Unknown hardware / CPU proxy: no datasheet peak, but a null MFU
+        # hides whether a change moved achieved FLOPs at all (the honest-
+        # progress prerequisite for the ragged-kernel roadmap item). Use a
+        # MEASURED dense-matmul peak of this backend as the denominator —
+        # labeled mfu_basis="measured_matmul" so the number is never read
+        # as a datasheet fraction. One host = one "chip" here (the virtual
+        # CPU mesh shares the same silicon).
+        mfu = goodput_flops / max(1.0, _measured_peak_flops())
+        mfu_basis = "measured_matmul"
 
     sat_sorted = sorted(sat_lat)
     open_sorted = sorted(open_lat) or [float("nan")]  # latency phase may be skipped
@@ -1207,6 +1590,11 @@ async def _run(model_size: str, n_requests: int, concurrency: int, n_services: i
         # mixed_plans_per_sec hetero vs drain at the same offered load,
         # head-of-line wait p99, degraded_share.
         "mixed": mixed,
+        # Speculative-decoding scenario (None when skipped): the same
+        # mixed stream served with speculation off (true per-token
+        # baseline) vs on — decode tok/s per mode, the speedup, per-class
+        # accept rates, and the greedy byte-parity verdict.
+        "spec": spec,
         # Per-phase latency attribution from sampled request traces (None
         # when skipped): p50/p99 of scheduler-queue vs engine admit-wait vs
         # prefill vs decode vs tool fan-out, plus each phase's share of the
@@ -1231,8 +1619,25 @@ async def _run(model_size: str, n_requests: int, concurrency: int, n_services: i
         "decode_tok_s": decode_tokens / max(1e-9, elapsed),
         "decode_forwards": decode_forwards,
         "tok_per_forward": decode_tokens / max(1.0, decode_forwards),
+        # Per-phase achieved tokens per model forward — the speculation
+        # amortisation split by phase (saturation vs open-loop), so a
+        # regression in either regime is attributable.
+        "phase_tok_per_forward": {
+            "sat": round(decode_tokens / max(1.0, decode_forwards), 2),
+            "open": round(
+                (prom2.get("mcpx_engine_decode_tokens_total", 0.0)
+                 - prom1.get("mcpx_engine_decode_tokens_total", 0.0))
+                / max(
+                    1.0,
+                    prom2.get("mcpx_engine_decode_forwards_total", 0.0)
+                    - prom1.get("mcpx_engine_decode_forwards_total", 0.0),
+                ),
+                2,
+            ),
+        },
         "prefill_tokens": prefill_tokens,
         "mfu": mfu,
+        "mfu_basis": mfu_basis,
         # Plan-cache accounting for repeat-intent runs (hit share over the
         # timed phase; 0.0 in the default cache-busting workload).
         "cache_hit_share": (
@@ -1482,6 +1887,8 @@ def main() -> None:
                 "tok_per_forward": round(stats["tok_per_forward"], 2),
                 "prefill_tokens": int(stats["prefill_tokens"]),
                 "mfu": round(stats["mfu"], 4) if stats["mfu"] is not None else None,
+                "mfu_basis": stats["mfu_basis"],
+                "phase_tok_per_forward": stats["phase_tok_per_forward"],
                 "phase_p50_ms": {
                     k: round(v, 1) for k, v in stats["phase_p50_ms"].items()
                 },
@@ -1512,6 +1919,21 @@ def main() -> None:
                 "errors": stats["errors"],
                 "overload": stats["overload"],
                 "mixed": stats["mixed"],
+                "spec": stats["spec"],
+                # Acceptance keys promoted to the top level (ISSUE 6): the
+                # same mixed stream served with speculation off vs on.
+                "spec_decode_tok_s": (
+                    stats["spec"]["spec_decode_tok_s"] if stats["spec"] else None
+                ),
+                "spec_speedup": (
+                    stats["spec"]["spec_speedup"] if stats["spec"] else None
+                ),
+                "spec_speedup_basis": (
+                    stats["spec"]["spec_speedup_basis"] if stats["spec"] else None
+                ),
+                "spec_accept_rate": (
+                    stats["spec"]["spec_accept_rate"] if stats["spec"] else None
+                ),
                 "latency_attribution": stats["latency_attribution"],
                 "chaos": stats["chaos"],
                 # Acceptance keys promoted to the top level (ISSUE 5): the

@@ -127,6 +127,94 @@ def check_jit_host_sync(ctx: FileContext) -> Iterator[Finding]:
                 )
 
 
+@rule(
+    "per-token-host-loop",
+    "host loop stepping a jitted decode fn with a per-iteration host sync "
+    "fed back into the next dispatch",
+)
+def check_per_token_host_loop(ctx: FileContext) -> Iterator[Finding]:
+    """The decode anti-pattern speculative decoding exists to kill: a
+    Python ``while``/``for`` that dispatches a jitted step function, host-
+    syncs its result (``int()``/``float()``/``.item()``/``np.asarray``/
+    ``jax.device_get``), and feeds the synced value back into the NEXT
+    dispatch — one full host↔device round trip per token, serialized by
+    construction (no pipelining, no batching can hide it). Distinct from
+    ``jit-host-sync``'s hot-loop mode, which flags per-iteration syncs
+    generally but sanctions ``jax.device_get``: here even the sanctioned
+    fetch is flagged, because the FEEDBACK edge — not the fetch itself —
+    is the serialization. Keep the token loop on device (``lax.while_loop``
+    / ``lax.scan``, as the engine's segment executables do) or widen the
+    window so one dispatch covers many tokens (speculative decoding,
+    ``EngineConfig.speculative``)."""
+    tree = ctx.tree
+    jitted = jitted_callable_names(tree)
+    if not jitted:
+        return
+    for loop in ast.walk(tree):
+        if not isinstance(loop, (ast.For, ast.While)):
+            continue
+        # Names holding a jitted call's (device) results in this loop.
+        device_names: set[str] = set()
+        for node in ast.walk(loop):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                if call_name(node.value) in jitted:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            device_names.add(t.id)
+                        elif isinstance(t, (ast.Tuple, ast.List)):
+                            device_names.update(
+                                e.id for e in t.elts if isinstance(e, ast.Name)
+                            )
+        if not device_names:
+            continue
+        # Names assigned from a host sync over a device value (the arg
+        # subtree may wrap it: `tok = int(jnp.argmax(logits))`).
+        synced: dict[str, tuple[int, str]] = {}
+        for node in ast.walk(loop):
+            if not (isinstance(node, ast.Assign) and isinstance(node.value, ast.Call)):
+                continue
+            what = _is_host_sync(node.value)
+            if what is None:
+                continue
+            touches_device = any(
+                isinstance(sub, ast.Name) and sub.id in device_names
+                for sub in ast.walk(node.value)
+            )
+            if not touches_device:
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    synced[t.id] = (node.lineno, what)
+        if not synced:
+            continue
+        # The feedback edge: a jitted call in the same loop consuming a
+        # synced name (order-insensitive — the edge closes across
+        # iterations either way).
+        seen: set[int] = set()
+        for node in ast.walk(loop):
+            if not (isinstance(node, ast.Call) and call_name(node) in jitted):
+                continue
+            consumed = {
+                sub.id
+                for a in (*node.args, *(kw.value for kw in node.keywords))
+                for sub in ast.walk(a)
+                if isinstance(sub, ast.Name)
+            }
+            for name in sorted(consumed & set(synced)):
+                line, what = synced[name]
+                if line in seen:
+                    continue
+                seen.add(line)
+                yield ctx.finding(
+                    line,
+                    "per-token-host-loop",
+                    f"per-iteration host sync '{what}' -> '{name}' feeds the "
+                    f"next '{call_name(node)}' dispatch — one device round "
+                    "trip per token; move the loop on device (lax.while_loop/"
+                    "scan) or widen the dispatch (speculative decoding)",
+                )
+
+
 def _static_argnames(call: ast.Call) -> set[str]:
     """Literal static_argnames of a jit call/decorator ({} when absent or
     not statically readable)."""

@@ -66,7 +66,8 @@ from mcpx.core.config import MCPXConfig
 from mcpx.core.errors import EngineError
 from mcpx.engine.kv_cache import PageAllocator, commit_prefill_to_pages, init_paged_kv
 from mcpx.engine.paged_decode import decode_chunk_paged
-from mcpx.engine.sampling import sample, sample_rows
+from mcpx.engine.sampling import accept_rows, sample, sample_rows, sample_window_rows
+from mcpx.engine.speculative import advance_drafter_state, draft_window
 from mcpx.models.gemma.config import GemmaConfig
 from mcpx.models.gemma.model import init_kv_cache, prefill
 from mcpx.models.gemma.params import load_or_init
@@ -76,6 +77,7 @@ from mcpx.planner.grammar import (
     build_plan_grammar,
     build_trivial_grammar,
     stacked_tables,
+    stacked_spec_tables,
 )
 from mcpx.scheduler.admission import ewma_update
 from mcpx.telemetry import tracing
@@ -164,7 +166,13 @@ class _Slab:
     """
 
     def __init__(
-        self, B: int, steps: int, pmax: int, pad_id: int, prompt_cap: int = 0
+        self,
+        B: int,
+        steps: int,
+        pmax: int,
+        pad_id: int,
+        prompt_cap: int = 0,
+        draft_dim: int = 1,
     ) -> None:
         self.B = B
         self.steps = steps
@@ -206,6 +214,15 @@ class _Slab:
         self.temp = np.zeros((B,), np.float32)
         self.cons = np.zeros((B,), bool)
         self.dfa = np.zeros((B,), np.int32)
+        # Recurrent drafter hidden state (grammar-aware speculative
+        # decoding, engine/speculative.py): an embedding-EWMA over the
+        # row's emitted tokens, [B, d_model]. Host mirror holds clear
+        # values only (zeros — a fresh row's drafter starts cold); the
+        # authoritative copy lives in slab.dev, advanced by the spec
+        # segment by each row's accepted count. Inert when speculation is
+        # off (scattered but never read, like temp/cons/dfa under
+        # hetero_batch=off).
+        self.hstate = np.zeros((B, max(1, draft_dim)), np.float32)
         # Sampling config shared by every resident row (reset when empty) —
         # the HOMOGENEOUS slab's compatibility triple (hetero_batch=off).
         self.constrained = True
@@ -223,6 +240,19 @@ class _Slab:
         # empty-slab admission — never mid-occupancy (admission pauses
         # until the old-mode rows drain).
         self.hetero = False
+        # Speculative-decoding latch, same refill-from-empty discipline:
+        # rows admitted under speculation carry the [K+1]-wide window's
+        # page-slack geometry and always decode through the spec segment;
+        # a live EngineConfig.speculative flip pauses admission until they
+        # drain (flip-safe by construction, like the hetero latch above).
+        # spec_k/spec_draft are the LATCHED window width and draft mode —
+        # dispatch must read these, never the live config: a mid-drain
+        # enabled/k/draft change would otherwise retrace an unwarmed
+        # executable (K and draft are static args) under rows admitted
+        # with the old window's page slack.
+        self.spec = False
+        self.spec_k = 0
+        self.spec_draft = "recurrent"
         # Device-resident copy of (cur, pos, st, emitted, done, budgets,
         # page_table, out_buf) between segments — None only at startup and
         # after a failure reset (host arrays are then authoritative). All
@@ -265,6 +295,7 @@ class _Slab:
         self.temp[i] = 0.0
         self.cons[i] = False
         self.dfa[i] = 0
+        self.hstate[i, :] = 0.0
         self.gen[i] += 1
         self.page_table[i, :] = 0
         if self.prefix[i] is not None:
@@ -409,6 +440,23 @@ class InferenceEngine:
         um[:n_real] = True
         um[self.tokenizer.pad_id] = False
         self._unconstrained_mask = jnp.asarray(um)
+        # Draftable vocab for FREE rows under speculative decoding: the
+        # unconstrained mask minus EOS — a stop must come from the verified
+        # sample (where done/state bookkeeping handles it), never ride in
+        # as an accepted draft.
+        um_free = um.copy()
+        um_free[self.tokenizer.eos_id] = False
+        self._draft_free_mask = jnp.asarray(um_free)
+        # Speculative-decoding accounting (worker-writes, queue_stats
+        # reads): running drafted/accepted totals per row class, swapped in
+        # whole like _pending_stats.
+        self._spec_totals = {
+            "drafted_constrained": 0,
+            "accepted_constrained": 0,
+            "drafted_free": 0,
+            "accepted_free": 0,
+        }
+        self._spec_window_degraded_logged = False
 
     # ------------------------------------------------------------- lifecycle
     def _transition(self, to: str) -> bool:
@@ -476,6 +524,7 @@ class InferenceEngine:
             self._jit_admit_merge = None
             self._jit_hetero_admit = None
             self._jit_hetero_segment = None
+            self._jit_hetero_segment_spec = None
             self._stack_cache = None
             self._inflight.clear()
             self._pending_admissions.clear()
@@ -558,6 +607,13 @@ class InferenceEngine:
         # population drain-to-switch used to starve), published by the
         # worker each iteration; ``depth`` above counts the pre-drain queue.
         ps = self._pending_stats
+        # Speculative-decoding acceptance (grammar-aware drafter): running
+        # accept rates overall and split by row class — the split is the
+        # design claim ("acceptance stays high exactly where decode is
+        # slowest") made observable. All zeros while speculation is off.
+        sp = self._spec_totals
+        drafted = sp["drafted_constrained"] + sp["drafted_free"]
+        accepted = sp["accepted_constrained"] + sp["accepted_free"]
         return {
             "depth": depth,
             "active": active,
@@ -569,6 +625,17 @@ class InferenceEngine:
             "resident_grammars": sum(
                 1 for k in range(1, len(self._dfa_slot_refs))
                 if self._dfa_slot_refs[k] > 0
+            ),
+            "spec_accept_rate": accepted / drafted if drafted else 0.0,
+            "spec_accept_rate_constrained": (
+                sp["accepted_constrained"] / sp["drafted_constrained"]
+                if sp["drafted_constrained"]
+                else 0.0
+            ),
+            "spec_accept_rate_free": (
+                sp["accepted_free"] / sp["drafted_free"]
+                if sp["drafted_free"]
+                else 0.0
             ),
         }
 
@@ -709,6 +776,36 @@ class InferenceEngine:
             static_argnames=("iters", "chunk"),
             donate_argnames=("paged_k", "paged_v"),
         )
+        # Grammar-aware speculative decoding (engine/speculative.py): the
+        # drafter-propose + one-forward-verify segment. K and the draft
+        # mode are config statics (ONE executable per config), never
+        # per-acceptance — variable accepted lengths are data.
+        self._jit_hetero_segment_spec = jax.jit(
+            self._hetero_segment_spec_impl,
+            static_argnames=("iters", "K", "draft"),
+            donate_argnames=("paged_k", "paged_v"),
+        )
+        if ecfg.speculative.enabled and ecfg.hetero_batch:
+            # The verify window samples [B, K+1]-shaped draws each forward;
+            # with the default non-partitionable threefry every mesh device
+            # redundantly generates the FULL bit tensor (measured ~2x the
+            # whole segment on the CPU proxy). Partitionable threefry
+            # shards bit generation with the data. Process-global and
+            # one-way by design: flipped only when speculation is armed, so
+            # a speculation-off engine keeps byte-identical streams.
+            try:
+                jax.config.update("jax_threefry_partitionable", True)
+            except Exception as e:  # noqa: BLE001 - perf knob, never fatal
+                log.warning("jax_threefry_partitionable unavailable: %s", e)
+        if ecfg.speculative.enabled and not ecfg.hetero_batch:
+            # Same loud-interaction convention as draft_mode below: the
+            # drafter's grammar pre-filter indexes the PER-ROW stacked DFA
+            # tables, which only the heterogeneous slab carries.
+            log.warning(
+                "speculative.enabled without hetero_batch has no effect: "
+                "the grammar-aware drafter needs the per-row stacked DFA "
+                "tables — set engine.hetero_batch=true to speculate"
+            )
         if ecfg.hetero_batch and ecfg.draft_mode == "prompt":
             # Not a validation error — both knobs default sensibly on their
             # own — but the interaction must be loud: an operator flipping
@@ -740,6 +837,9 @@ class InferenceEngine:
             # prefill bucket (suffix tokens only; the shared-prefix header
             # is fixed boilerplate with nothing worth drafting from).
             prompt_cap=max(fitting) if fitting else 1,
+            # Recurrent drafter hidden width = the model width (the state
+            # is scored against the tied unembedding).
+            draft_dim=self.model_cfg.d_model,
         )
         if ecfg.warmup_compile:
             self._warmup()
@@ -776,10 +876,22 @@ class InferenceEngine:
         their tables are live. Worker thread only."""
         pad = self._grammar_pad()
         slots = [g if g is not None else self._trivial_grammar for g in self._dfa_slots]
-        key = (tuple(id(g) for g in slots), pad)
+        # The slab latch keeps the spec companions alive through a live
+        # flip-off drain: resident spec rows still dispatch the 7-table
+        # executable until they retire.
+        spec = self._spec_k() > 0 or self._slab.spec
+        key = (tuple(id(g) for g in slots), pad, spec)
         if self._stack_cache is not None and self._stack_cache[0] == key:
             return self._stack_cache[2]
         host = stacked_tables(slots, pad)
+        if spec:
+            # Speculative companions (same slot snapshot, same pad
+            # geometry): the precomputed successor-distance table and the
+            # token->column inverse map the spec segment's one-gather
+            # finishability and vocab-space verify sampling need. Built
+            # only when speculation is armed — they double the stack's
+            # device footprint.
+            host = host + stacked_spec_tables(slots, pad)
         tables = tuple(jax.device_put(t, self._named(P())) for t in host)
         self._stack_cache = (key, tuple(slots), tables)
         return tables
@@ -889,7 +1001,7 @@ class InferenceEngine:
             active0 = self._put(np.zeros((A,), bool), rs_a)
             if ecfg.hetero_batch:
                 admit_out = self._jit_hetero_admit(
-                    *sdfa,
+                    *sdfa[:5],
                     last,
                     budgets0,
                     active0,
@@ -928,6 +1040,9 @@ class InferenceEngine:
                 self._put(np.zeros((A,), np.float32), rs_a),
                 self._put(np.zeros((A,), bool), rs_a),
                 self._put(np.zeros((A,), np.int32), rs_a),
+                self._put(
+                    np.zeros((A, self._slab.hstate.shape[1]), np.float32), rs_a2
+                ),
             )
         slab = self._slab
         chunk = self._spec_chunk(True)
@@ -937,7 +1052,7 @@ class InferenceEngine:
         if ecfg.hetero_batch:
             out = self._jit_hetero_segment(
                 self._params,
-                *sdfa,
+                *sdfa[:5],
                 *self._put_slab_state(slab),
                 self._paged_kv["k"],
                 self._paged_kv["v"],
@@ -951,6 +1066,29 @@ class InferenceEngine:
                 iters=iters,
                 chunk=chunk,
             )
+            self._paged_kv = {"k": out[5], "v": out[6]}
+            if self._spec_k() > 0:
+                # Speculation armed: warm ITS segment executable too (the
+                # legacy hetero one above stays warm for a live rollback
+                # flip — both coexist, like hetero vs homogeneous).
+                out = self._jit_hetero_segment_spec(
+                    self._params,
+                    *sdfa,
+                    *self._put_slab_state(slab),
+                    self._paged_kv["k"],
+                    self._paged_kv["v"],
+                    *self._put_many(
+                        (slab.out_buf, rs_b2),
+                        (slab.temp, rs_b),
+                        (slab.cons, rs_b),
+                        (slab.dfa, rs_b),
+                        (slab.hstate, rs_b2),
+                    ),
+                    key,
+                    iters=iters,
+                    K=self._spec_k(),
+                    draft=ecfg.speculative.draft,
+                )
         else:
             out = self._jit_segment(
                 self._params,
@@ -1014,8 +1152,9 @@ class InferenceEngine:
         draft-lookup state (prompt_toks, prompt_lens, prev); 11..13 the
         per-row sampling config (temperature, constrained, dfa_id —
         heterogeneous batching; scattered but unread when hetero_batch is
-        off). Initialised from the host arrays (startup / after a failure
-        reset) when absent."""
+        off); 14 the recurrent drafter state (speculative decoding;
+        scattered but unread when speculation is off). Initialised from
+        the host arrays (startup / after a failure reset) when absent."""
         if slab.dev is None:
             rs = self._row_spec(slab.B)
             rs2 = self._row_spec(slab.B, 1)
@@ -1027,6 +1166,7 @@ class InferenceEngine:
                 (slab.temp, rs),
                 (slab.cons, rs),
                 (slab.dfa, rs),
+                (slab.hstate, rs2),
             )
         return slab.dev
 
@@ -1046,6 +1186,7 @@ class InferenceEngine:
         temp,
         cons,
         dfa,
+        hst,
         rows,
         cur_v,
         pos_v,
@@ -1061,6 +1202,7 @@ class InferenceEngine:
         temp_v,
         cons_v,
         dfa_v,
+        hst_v,
     ):
         """Scatter per-row values into the slab's device state: row
         ``rows[j]`` takes the j-th value of every value array. This is how
@@ -1084,6 +1226,7 @@ class InferenceEngine:
             temp.at[rows].set(temp_v, mode="drop"),
             cons.at[rows].set(cons_v, mode="drop"),
             dfa.at[rows].set(dfa_v, mode="drop"),
+            hst.at[rows].set(hst_v, mode="drop"),
         )
 
     def _admit_merge_impl(
@@ -1102,6 +1245,7 @@ class InferenceEngine:
         temp,
         cons,
         dfa,
+        hst,
         rows,
         cur0,
         st0,
@@ -1115,6 +1259,7 @@ class InferenceEngine:
         temp_v,
         cons_v,
         dfa_v,
+        hst_v,
     ):
         """Scatter a freshly-prefilled admission cohort into the device slab
         state with ZERO host fetches: ``cur0``/``st0``/``done0`` are
@@ -1148,6 +1293,7 @@ class InferenceEngine:
             temp.at[rows].set(temp_v, mode="drop"),
             cons.at[rows].set(cons_v, mode="drop"),
             dfa.at[rows].set(dfa_v, mode="drop"),
+            hst.at[rows].set(hst_v, mode="drop"),
         )
 
     def _poll_admissions(self, slab: "_Slab") -> None:
@@ -1226,6 +1372,7 @@ class InferenceEngine:
                 (np.zeros((B,), np.float32), rs),
                 (np.zeros((B,), bool), rs),
                 (np.zeros((B,), np.int32), rs),
+                (np.zeros((B, slab.hstate.shape[1]), np.float32), rs2),
             ),
         )
 
@@ -1243,7 +1390,11 @@ class InferenceEngine:
         figure — callers sending a prefix must clamp against this."""
         ecfg = self.config.engine
         capacity = ecfg.max_pages_per_seq * ecfg.kv_page_size
-        chunk = self._spec_chunk(True)
+        # The worst garbage-write slack either decode path needs: the DFA
+        # fast-forward chunk or the speculative verify window (whichever
+        # the live config arms wider) — callers must fit both because the
+        # slab may serve them either way across its lifetime.
+        chunk = max(self._spec_chunk(True), self._spec_k() + 1)
         slack = chunk if chunk > 1 else 0
         budget = min(max_new_tokens or ecfg.max_decode_len, max(1, min(ecfg.max_decode_len, capacity - 1 - slack)))
         full_eligible = [b for b in self._prefill_buckets if b <= capacity]
@@ -1298,6 +1449,29 @@ class InferenceEngine:
                 want, got, capacity, ecfg.max_decode_len,
             )
         return got
+
+    def _spec_k(self) -> int:
+        """Draft tokens per verify forward under grammar-aware speculative
+        decoding (EngineConfig.speculative) — 0 when the subsystem is
+        inert: disabled, hetero_batch off (the drafter's grammar pre-filter
+        needs the per-row stacked DFAs), or page capacity leaving no slack
+        for the [K+1]-wide window's garbage writes (degrades toward 0
+        rather than failing, logged once, mirroring _spec_chunk)."""
+        ecfg = self.config.engine
+        if not (ecfg.hetero_batch and ecfg.speculative.enabled):
+            return 0
+        capacity = ecfg.max_pages_per_seq * ecfg.kv_page_size
+        budget_ceiling = min(ecfg.max_decode_len, capacity - 1)
+        window = max(1, min(ecfg.speculative.k + 1, capacity - budget_ceiling))
+        if window - 1 < ecfg.speculative.k and not self._spec_window_degraded_logged:
+            self._spec_window_degraded_logged = True
+            log.warning(
+                "speculative window degraded k=%d -> %d: page capacity %d "
+                "leaves no slack past max_decode_len=%d (raise "
+                "max_pages_per_seq/kv_page_size or lower max_decode_len)",
+                ecfg.speculative.k, window - 1, capacity, ecfg.max_decode_len,
+            )
+        return window - 1
 
     # --- jitted bodies ----------------------------------------------------
     def _budget_mask(self, dfa, st, rem):
@@ -2090,6 +2264,243 @@ class InferenceEngine:
         )
         return cur, pos, st, e, done, k_p, v_p, buf, it
 
+    def _hetero_segment_spec_impl(
+        self,
+        params,
+        sdfa_trans,
+        sdfa_mask,
+        sdfa_dist,
+        sdfa_active,
+        sdfa_eos,
+        sdfa_dist_succ,
+        sdfa_inv,
+        cur,
+        pos,
+        st,
+        emitted,
+        done,
+        budgets,
+        page_table,
+        paged_k,
+        paged_v,
+        out_buf,
+        temp_v,
+        cons_v,
+        dfa_id,
+        hstate,
+        key,
+        *,
+        iters: int,
+        K: int,
+        draft: str,
+    ):
+        """One bounded SPECULATIVE decode segment over the heterogeneous
+        slab (grammar-aware speculative decoding; engine/speculative.py has
+        the drafter design). Each of the up-to-``iters`` iterations:
+
+          1. **Draft**: the recurrent drafter proposes up to ``K`` tokens
+             per row, pre-filtered through the row's stacked grammar DFA
+             (``draft_window``) — constrained rows only ever draft
+             admissible, budget-finishable, non-EOS tokens (single-
+             successor states are forced, so plan scaffolding drafts
+             itself); free rows (``dfa_id == 0``) draft unmasked from the
+             drafter scores.
+          2. **Verify**: ONE chunked forward over the fixed ``[B, K+1]``
+             window ``[cur, drafts...]`` yields logits at every position;
+             every position of every row is then sampled in ONE fused
+             vocab-space pass (``sample_window_rows`` with a shared Gumbel
+             tensor): the per-position admissibility masks fall out of the
+             drafter's DFA walk for free, are gathered to vocab space
+             through ``sdfa_inv`` (token → compact column), and free rows
+             substitute the static unconstrained mask — one select and one
+             argmax over ``[B, K+1, V]`` instead of separate compact and
+             full-vocab draws. ``active_ids`` are strictly increasing per
+             grammar, so the vocab-space argmax tie-breaks exactly like the
+             legacy segment's compact-space argmax: greedy draws stay
+             bit-identical (the parity invariant, tested).
+          3. **Accept**: the sequential-sample rule (``accept_rows``): a
+             row keeps the longest draft prefix its samples reproduce; the
+             first mismatching sample is the correction token — so every
+             forward nets ``accepted + 1`` tokens and emits, for any
+             temperature, exactly what token-by-token decode would
+             (greedy byte-identical, tested).
+
+        Per-row accepted lengths are DATA (``emitted`` advances by
+        ``a + 1``); the window never changes shape, so one executable
+        serves every acceptance pattern, grammar mix and sampling config.
+        Rejected window positions wrote garbage KV past the accepted end —
+        the next iteration's window (which starts there) overwrites them,
+        the same contract the fast-forward chunk relies on; admission
+        reserves ``K+1`` pages of slack per row for exactly this.
+
+        The ``iters`` loop is UNROLLED at trace time (a Python loop over a
+        static count), not a ``lax.while_loop``: the loop carry would
+        force per-iteration double-buffering of the KV pools on backends
+        whose while lowering cannot alias them, which measured several
+        times the body's own cost — and the early-exit the while loop
+        bought only pays on an all-done slab (the drain tail), where the
+        extra iterations are cheap no-ops (every row masked done). Returns
+        (cur, pos, st, emitted, done, pools_k, pools_v, out_buf, hstate,
+        drafted [B], accepted [B], n_forwards)."""
+        cfg = self.model_cfg
+        tok = self.tokenizer
+        B = cur.shape[0]
+        W = out_buf.shape[1]
+        # draft_window consumes the precomputed successor-distance table in
+        # the dist slot: budget-finishability costs ONE gather per visited
+        # state instead of a chained transition-then-distance pair.
+        sdfa_draft = (sdfa_trans, sdfa_mask, sdfa_dist_succ, sdfa_active, sdfa_eos)
+        pad, eos = tok.pad_id, tok.eos_id
+        V = self._unconstrained_mask.shape[0]
+        b_idx = jnp.arange(B)
+        j_ar = jnp.arange(K + 1)
+
+        def body(c):
+            cur, pos, st, e, done, k_p, v_p, buf, h, n_dr, n_ac, key = c
+
+            # --- 1. draft K tokens per row through the grammar pre-filter.
+            # The walk also emits the verify window's per-position
+            # admissibility masks (it gathered them anyway at exactly the
+            # states verification samples from).
+            p_toks, p_use, s_before, s_fin, masks_w = draft_window(
+                params["embed"],
+                sdfa_draft,
+                dfa_id,
+                st,
+                cur,
+                h,
+                e,
+                budgets,
+                done,
+                cons_v,
+                self._draft_free_mask,
+                pad,
+                k=K,
+                mode=draft,
+            )
+
+            # --- 2. ONE verify forward over the fixed [B, K+1] window.
+            window = jnp.concatenate([cur[:, None], p_toks], axis=1)
+            logits_w, kv = decode_chunk_paged(
+                params,
+                cfg,
+                window,
+                pos,
+                page_table,
+                {"k": k_p, "v": v_p},
+                use_pallas=self._use_pallas,
+                interpret=self.config.engine.interpret,
+            )  # [B, K+1, V] float32
+
+            # Per-position verification samples: position j is masked at
+            # the DFA state after the window prefix 0..j with the budget
+            # remaining at emission index e+j — exactly what sequential
+            # decode would mask with there (``masks_w``, emitted by the
+            # draft walk). The masks are gathered out of compact column
+            # space into vocab space through the stacked inverse-column
+            # table so constrained and free rows share ONE fused draw.
+            col_of = sdfa_inv[dfa_id]  # [B, V] token -> column, -1 inactive
+            vmask = jnp.take_along_axis(
+                masks_w,
+                jnp.broadcast_to(
+                    jnp.clip(col_of, 0)[:, None, :], (B, K + 1, V)
+                ),
+                axis=-1,
+            ) & (col_of >= 0)[:, None, :]
+            mask_w = jnp.where(
+                cons_v[:, None, None],
+                vmask,
+                self._unconstrained_mask[None, None, :],
+            )
+            key, sub = jax.random.split(key)
+            # ONE full-vocab Gumbel tensor + ONE argmax serves every row
+            # and position (sample_window_rows' gumbel path): greedy rows
+            # add zeroed noise so their winner is the masked argmax, hot
+            # rows draw via the Gumbel-max identity — on the CPU proxy the
+            # second bit-generation pass and the two categorical
+            # log-softmaxes this fuses away cost more than the verify
+            # forward itself.
+            gum = jax.random.gumbel(sub, logits_w.shape, jnp.float32)
+            tok_w = sample_window_rows(
+                logits_w,
+                temp_v,
+                top_k=self.config.engine.top_k,
+                mask=mask_w,
+                gumbel=gum,
+            ).astype(jnp.int32)  # [B, K+1]
+
+            # --- 3. accept the longest sample-reproduced draft prefix;
+            # the sample at the first mismatch is the correction.
+            acc, a = accept_rows(tok_w[:, :K], p_toks, p_use)
+            e1 = e + a
+            nxt_tok = tok_w[b_idx, a]
+            # Winning token back to its compact column for the DFA advance
+            # (>= 0 wherever cons_v selects it: constrained samples come
+            # from the admissible support by construction).
+            col_a = jnp.clip(col_of[b_idx, nxt_tok], 0)
+            s_full = jnp.concatenate([s_before, s_fin[:, None]], axis=1)
+            st1 = s_full[b_idx, a]
+            ended = jnp.where(cons_v, sdfa_eos[dfa_id, col_a], nxt_tok == eos)
+            newly_done = done | ended | (e1 >= budgets)
+            st_next = jnp.where(
+                newly_done | ~cons_v, st1, sdfa_trans[dfa_id, st1, col_a]
+            )
+            nxt = jnp.where(newly_done, pad, nxt_tok)
+
+            idx_p = jnp.where(acc, e[:, None] + j_ar[None, :K], W)
+            buf = buf.at[b_idx[:, None], idx_p].set(p_toks, mode="drop")
+            buf = buf.at[b_idx, jnp.where(newly_done, W, e1)].set(
+                nxt, mode="drop"
+            )
+            adv = jnp.where(done, 0, 1) + a  # done rows drafted nothing
+            if draft == "recurrent":
+                # Drafter state after absorbing cur + the accepted drafts
+                # (the correction becomes the next cur, absorbed next
+                # round); closed form, no scan.
+                h2 = jnp.where(
+                    done[:, None],
+                    h,
+                    advance_drafter_state(h, params["embed"], window, a + 1),
+                )
+            else:
+                h2 = h  # grammar mode never reads the drafter state
+            return (
+                nxt,
+                pos + adv,
+                st_next,
+                e1 + jnp.where(newly_done, 0, 1),
+                newly_done,
+                kv["k"],
+                kv["v"],
+                buf,
+                h2,
+                n_dr + jnp.sum(p_use, axis=1).astype(jnp.int32),
+                n_ac + a,
+                key,
+            )
+
+        c = (
+            cur,
+            pos,
+            st,
+            emitted,
+            done,
+            paged_k,
+            paged_v,
+            out_buf,
+            hstate,
+            jnp.zeros((B,), jnp.int32),
+            jnp.zeros((B,), jnp.int32),
+            key,
+        )
+        for _ in range(max(1, iters)):
+            c = body(c)
+        cur, pos, st, e, done, k_p, v_p, buf, h, n_dr, n_ac, key = c
+        return (
+            cur, pos, st, e, done, k_p, v_p, buf, h, n_dr, n_ac,
+            jnp.asarray(max(1, iters), jnp.int32),
+        )
+
     # --- worker -----------------------------------------------------------
     def _worker(self) -> None:
         try:
@@ -2242,13 +2653,18 @@ class InferenceEngine:
             return
         if slab.n_active == 0:
             slab.hetero = ecfg.hetero_batch  # mode latch: see _Slab.hetero
-        elif slab.hetero != ecfg.hetero_batch:
-            # The flag flipped while rows admitted under the OLD mode are
-            # still decoding: their page-slack geometry belongs to that
+            slab.spec_k = self._spec_k()  # speculative latch, same rules
+            slab.spec = slab.spec_k > 0
+            slab.spec_draft = ecfg.speculative.draft
+        elif slab.hetero != ecfg.hetero_batch or slab.spec_k != self._spec_k() or (
+            slab.spec and slab.spec_draft != ecfg.speculative.draft
+        ):
+            # A mode flag flipped while rows admitted under the OLD mode
+            # are still decoding: their page-slack geometry belongs to that
             # mode, so pause admission and let them drain — the flip lands
             # at the next empty-slab admission. This is what makes a
-            # runtime flip (bench mixed phase, operator rollback) safe
-            # rather than merely documented-safe.
+            # runtime flip (bench mixed/spec phases, operator rollback)
+            # safe rather than merely documented-safe.
             return
         hetero = slab.hetero
         if not hetero and slab.n_active == 0:
@@ -2331,7 +2747,13 @@ class InferenceEngine:
         # Hetero slabs always run the constrained-width chunk (the segment
         # is one executable for every mix; unconstrained rows just never
         # force), so every row's pages carry the chunk's garbage-write slack.
-        spec_chunk = self._spec_chunk(True if hetero else slab.constrained)
+        # Under the speculative latch the window is [K+1] wide instead —
+        # rejected draft positions write garbage KV past the accepted end,
+        # so rows need that window's slack.
+        if hetero and slab.spec:
+            spec_chunk = slab.spec_k + 1
+        else:
+            spec_chunk = self._spec_chunk(True if hetero else slab.constrained)
         slack = spec_chunk if spec_chunk > 1 else 0
         capacity = ecfg.max_pages_per_seq * ecfg.kv_page_size
         budget_cap = min(slab.steps, capacity - 1 - slack - P)
@@ -2534,7 +2956,7 @@ class InferenceEngine:
             )
             if hetero:
                 cur0, st0, done0 = self._jit_hetero_admit(
-                    *sdfa,
+                    *sdfa[:5],
                     last_logits,
                     budgets_d,
                     active_d,
@@ -2639,11 +3061,17 @@ class InferenceEngine:
             state = self._dev_state(slab)
             # budgets_d/table_d from the admission upload are still live
             # (prefill donates only the pools) — reuse, don't re-upload.
-            rows_d, pos_d, ptoks_d, prev_d = self._put_many(
+            rows_d, pos_d, ptoks_d, prev_d, hst_d = self._put_many(
                 (rows_arr, rs),
                 (pos_arr, rs),
                 (ptoks_arr, self._row_spec(A, 1)),
                 (prev_arr, rs),
+                # Fresh rows start with a cold drafter state (zeros): the
+                # recurrence warms up over the row's own emissions.
+                (
+                    np.zeros((A, slab.hstate.shape[1]), np.float32),
+                    self._row_spec(A, 1),
+                ),
             )
             slab.dev = self._jit_admit_merge(
                 *state,
@@ -2660,6 +3088,7 @@ class InferenceEngine:
                 temp_d,  # still live, same reason
                 cons_d,
                 dfa_d,
+                hst_d,
             )
         except BaseException as e:  # mcpx: ignore[broad-except] - rows already assigned; e propagates to every resident request future
             self._fail_rows(slab, e)
@@ -2717,13 +3146,44 @@ class InferenceEngine:
         self._seg_counter += 1
         (
             cur_d, pos_d, st_d, e_d, done_d, budgets_d, pt_d, buf_in,
-            ptoks_d, plens_d, prev_d, temp_d, cons_d, dfa_d,
+            ptoks_d, plens_d, prev_d, temp_d, cons_d, dfa_d, hst_d,
         ) = self._dev_state(slab)
         prng = jax.random.PRNGKey((self._rng_base + self._seg_counter) & 0x7FFFFFFF)
-        if hetero:
-            out = self._jit_hetero_segment(
+        dr_d = ac_d = cons_snap = None
+        if hetero and slab.spec:
+            out = self._jit_hetero_segment_spec(
                 self._params,
                 *self._stacked_dfa(),
+                cur_d,
+                pos_d,
+                st_d,
+                e_d,
+                done_d,
+                budgets_d,
+                pt_d,
+                self._paged_kv["k"],
+                self._paged_kv["v"],
+                buf_in,
+                temp_d,
+                cons_d,
+                dfa_d,
+                hst_d,
+                prng,
+                iters=iters,
+                K=slab.spec_k,
+                draft=slab.spec_draft,
+            )
+            (
+                cur_d, pos_d, st_d, e_d, done_d, k_p, v_p, buf_d, hst_d,
+                dr_d, ac_d, n_fwd,
+            ) = out
+            # Class snapshot at dispatch: the drafted/accepted vectors the
+            # lagged harvest fetches belong to the rows resident NOW.
+            cons_snap = slab.cons.copy()
+        elif hetero:
+            out = self._jit_hetero_segment(
+                self._params,
+                *self._stacked_dfa()[:5],
                 cur_d,
                 pos_d,
                 st_d,
@@ -2771,12 +3231,56 @@ class InferenceEngine:
         self._paged_kv = {"k": k_p, "v": v_p}
         slab.dev = (
             cur_d, pos_d, st_d, e_d, done_d, budgets_d, pt_d, buf_d,
-            ptoks_d, plens_d, prev_d, temp_d, cons_d, dfa_d,
+            ptoks_d, plens_d, prev_d, temp_d, cons_d, dfa_d, hst_d,
         )
         # Dispatch timestamp only when some resident request is traced: the
         # disabled/unsampled hot path must not even pay the clock read.
         t_disp = time.monotonic() if slab.n_traced else 0.0
-        self._inflight.append((done_d, e_d, buf_d, n_fwd, slab.gen.copy(), t_disp))
+        self._inflight.append(
+            (
+                done_d, e_d, buf_d, n_fwd, slab.gen.copy(), t_disp,
+                # Speculation accounting handles (None on the non-spec
+                # paths): per-row drafted/accepted totals of THIS segment
+                # plus the dispatch-time class snapshot they attribute by.
+                (dr_d, ac_d) if dr_d is not None else None,
+                cons_snap,
+            )
+        )
+
+    def _account_speculation(
+        self, dr: np.ndarray, ac: np.ndarray, cons_snap: np.ndarray
+    ) -> None:
+        """Fold one harvested segment's per-row drafted/accepted vectors
+        into the running per-row-class totals, the Prometheus counters and
+        the accept-rate gauges. Worker thread only; ``_spec_totals`` is
+        swapped in whole (GIL-atomic) for queue_stats()'s cross-thread
+        read, like ``_pending_stats``."""
+        dc = int(dr[cons_snap].sum())
+        df = int(dr.sum()) - dc
+        acc_c = int(ac[cons_snap].sum())
+        acc_f = int(ac.sum()) - acc_c
+        if not (dc or df):
+            return
+        t = self._spec_totals
+        t = {
+            "drafted_constrained": t["drafted_constrained"] + dc,
+            "accepted_constrained": t["accepted_constrained"] + acc_c,
+            "drafted_free": t["drafted_free"] + df,
+            "accepted_free": t["accepted_free"] + acc_f,
+        }
+        self._spec_totals = t
+        if dc:
+            self.metrics.spec_drafted.labels(cls="constrained").inc(dc)
+            self.metrics.spec_accepted.labels(cls="constrained").inc(acc_c)
+            self.metrics.spec_accept_rate.labels(cls="constrained").set(
+                t["accepted_constrained"] / t["drafted_constrained"]
+            )
+        if df:
+            self.metrics.spec_drafted.labels(cls="free").inc(df)
+            self.metrics.spec_accepted.labels(cls="free").inc(acc_f)
+            self.metrics.spec_accept_rate.labels(cls="free").set(
+                t["accepted_free"] / t["drafted_free"]
+            )
 
     def _harvest(self, slab: "_Slab", keep_inflight: int) -> None:
         """Fetch flags + out_buf of in-flight segments (oldest first) until
@@ -2788,12 +3292,22 @@ class InferenceEngine:
         against a done-flag from before a row was re-admitted retiring the
         row's NEW request."""
         while len(self._inflight) > keep_inflight:
-            done_d, e_d, buf_d, nfwd_d, gen_snap, t_disp = self._inflight.popleft()
+            (
+                done_d, e_d, buf_d, nfwd_d, gen_snap, t_disp, spec_h, cons_snap,
+            ) = self._inflight.popleft()
             # ONE combined fetch (flags + out_buf): the tunnel's cost is the
             # round trip (~72ms), not the ~24KB of buffer — splitting into
             # flags-then-buf would add a second round trip on every
-            # retirement tick, which at steady state is most ticks.
-            done, e, buf, n_fwd = jax.device_get((done_d, e_d, buf_d, nfwd_d))
+            # retirement tick, which at steady state is most ticks. The
+            # speculation counters ([B] ints) ride the same fetch.
+            dr = ac = None
+            if spec_h is not None:
+                done, e, buf, n_fwd, dr, ac = jax.device_get(
+                    (done_d, e_d, buf_d, nfwd_d) + spec_h
+                )
+                self._account_speculation(dr, ac, cons_snap)
+            else:
+                done, e, buf, n_fwd = jax.device_get((done_d, e_d, buf_d, nfwd_d))
             # The blocking fetch above implies every earlier admission chain
             # has executed — resolve their timings before retiring rows that
             # may have finished in their very first segment.
@@ -2817,15 +3331,20 @@ class InferenceEngine:
                     slab.emitted[i] = e[i]
                     if delta <= 0 and not done[i]:
                         continue
-                    r.span.child(
-                        "engine.segment",
-                        t0=t_disp,
-                        t1=t1,
+                    attrs = dict(
                         tokens=delta,
                         dfa_id=int(slab.dfa[i]),
                         cls="constrained" if slab.cons[i] else "free",
                         forwards=int(n_fwd),
                     )
+                    if dr is not None:
+                        # Speculation attribution per traced row: how many
+                        # tokens this segment drafted for the row and how
+                        # many survived verification — the per-trace view
+                        # of where the speculative win (or miss) landed.
+                        attrs["drafted"] = int(dr[i])
+                        attrs["accepted"] = int(ac[i])
+                    r.span.child("engine.segment", t0=t_disp, t1=t1, **attrs)
             retired = False
             for i in range(slab.B):
                 r = slab.req[i]

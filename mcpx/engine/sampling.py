@@ -69,3 +69,72 @@ def sample_rows(
         scaled = jnp.where(scaled < kth, NEG_INF, scaled)
     stochastic = jax.random.categorical(key, scaled, axis=-1)
     return jnp.where(temperature <= 0.0, greedy, stochastic)
+
+
+def sample_window_rows(
+    logits: jax.Array,
+    temperature: jax.Array,
+    *,
+    top_k: int = 0,
+    mask: Optional[jax.Array] = None,
+    gumbel: jax.Array,
+) -> jax.Array:
+    """Sample token ids at EVERY position of a [B, W, V] speculation window
+    with a per-row temperature vector ([B] float): position w of row b is
+    sampled exactly as :func:`sample_rows` would sample that position's
+    [B, V] logits — greedy rows take the masked argmax (identical
+    mask-then-argmax order, so greedy draws are bit-identical to the
+    sequential path), stochastic rows draw independent categorical samples
+    per position at the row's own temperature. ``mask`` is [B, W, V] (e.g.
+    per-position grammar admissibility) or [V] (the static vocab mask),
+    broadcast over the window. Returns [B, W] sampled indices.
+
+    ``gumbel`` is a caller-supplied [B, W, V] Gumbel(0, 1) noise tensor:
+    stochastic draws are ``argmax(scaled + gumbel)`` (the Gumbel-max
+    identity ``categorical(p) == argmax(log p + g)``). Beyond sharing one
+    PRNG tensor across callers, the gumbel formulation FUSES the greedy
+    and stochastic draws into a single argmax: greedy rows get scale 1 and
+    zeroed noise, so their winner is bit-identical to
+    ``argmax(masked logits)`` (``x / 1.0`` and ``x + 0.0`` are exact in
+    IEEE float), while hot rows get ``logits / temp + gumbel``. One select
+    pass and one argmax pass over the [B, W, V] window instead of two of
+    each — on CPU-class backends those full-window passes, not the model
+    forward, are the marginal cost of a wider speculation window."""
+    logits = logits.astype(jnp.float32)
+    if mask is not None:
+        logits = jnp.where(mask, logits, NEG_INF)
+    hot = temperature > 0.0
+    scale = jnp.where(hot, jnp.maximum(temperature, 1e-6), 1.0)
+    scaled = logits / scale[:, None, None]
+    if top_k > 0 and top_k < logits.shape[-1]:
+        kth = jax.lax.top_k(scaled, top_k)[0][..., -1:]
+        scaled = jnp.where(scaled < kth, NEG_INF, scaled)
+    return jnp.argmax(
+        scaled + gumbel * hot.astype(jnp.float32)[:, None, None], axis=-1
+    )
+
+
+def accept_rows(
+    samples: jax.Array,  # [B, K] verification samples per window position
+    proposals: jax.Array,  # [B, K] drafted tokens
+    valid: jax.Array,  # [B, K] proposal validity (drafted at all)
+) -> tuple[jax.Array, jax.Array]:
+    """Per-row speculative acceptance — the greedy AND stochastic accept
+    rule in one formula. Position j's verification sample is drawn from the
+    target model's distribution *conditioned on the draft prefix* (the one
+    batched verify forward provides exactly those logits), so the rule
+
+        accept draft j while it equals position j's sample;
+        the first mismatching sample IS the correction token
+
+    emits, for every temperature, exactly the tokens sequential token-by-
+    token decode would emit: greedy rows' samples are the masked argmax
+    (deterministic ⇒ byte-identical outputs, tested), and stochastic rows'
+    first mismatch is a true sample from the conditional given the accepted
+    prefix — distribution-preserving with no draft probabilities needed
+    (the accepted prefix made proposal and sample coincide, so the
+    conditioning is the realised prefix either way). Returns
+    (``accepted`` [B, K] prefix flags, ``n_accepted`` [B] int32)."""
+    ok = valid & (samples == proposals)
+    accepted = jnp.cumprod(ok.astype(jnp.int32), axis=1).astype(bool)
+    return accepted, jnp.sum(accepted, axis=1).astype(jnp.int32)

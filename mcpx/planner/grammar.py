@@ -354,6 +354,88 @@ def stacked_tables(
     return trans, mask, dist, ids, eos
 
 
+def stacked_spec_tables(
+    grammars: "list[PlanGrammar]", pad_multiple: int = 512
+) -> tuple[np.ndarray, np.ndarray]:
+    """Speculative-decoding companions to :func:`stacked_tables`, same
+    stack order and pad geometry (state/column buckets MUST match — the
+    engine builds both from one slot snapshot):
+
+      - ``dist_succ [G, S, C]`` int32 — min samples to finish AFTER taking
+        column c from state s (``dist[g, trans[g, s, c]]`` precomputed at
+        stack build), so the hot path's budget-finishability check costs
+        ONE gather instead of the chained transition-then-distance pair —
+        per draft step AND per verify window position;
+      - ``inv_cols [G, V]`` int32 — token id → compact column, ``-1``
+        where the token is not active in that grammar (the stacked
+        counterpart of ``device_tables``'s ``inv_cols``). Lets the verify
+        sampling run ONCE in vocab space (admissibility gathered out to
+        [B, W, V], one fused draw for constrained and free rows alike) and
+        map the winning token back to its column for the DFA advance.
+        ``active_ids`` are strictly increasing per grammar, so a vocab-
+        space argmax tie-breaks exactly like the compact-space argmax —
+        the greedy-parity invariant survives the change of basis.
+    """
+    if not grammars:
+        raise ValueError("stacked_spec_tables needs at least one grammar")
+    S = max(
+        ((g.n_states + pad_multiple - 1) // pad_multiple) * pad_multiple
+        for g in grammars
+    )
+    C = max(_col_bucket(g.n_active) for g in grammars)
+    G = len(grammars)
+    V = grammars[0].tokenizer.vocab_size
+    dist_succ = np.full((G, S, C), _DIST_INF, np.int32)
+    inv = np.full((G, V), -1, np.int32)
+    for gi, g in enumerate(grammars):
+        n, c = g.ctrans.shape
+        d = np.full((S,), _DIST_INF, np.int32)
+        d[:n] = g.dist
+        tr = np.full((S, C), g.cdead, np.int32)
+        tr[:n, :c] = g.ctrans
+        dist_succ[gi] = d[tr]
+        inv[gi, g.active_ids] = np.arange(c, dtype=np.int32)
+    return dist_succ, inv
+
+
+def stacked_window_admissibility(sdfa_tables, dfa_id, states, rem):
+    """Batched multi-step admissibility masks for a K-token speculation
+    window over STACKED grammar tables (jnp arrays; called inside the
+    engine's speculative verify executable, ``_hetero_segment_spec_impl``).
+
+    ``states`` [B, W] is the per-position DFA state after consuming the
+    window prefix up to that position; ``rem`` [B, W] the remaining sample
+    budget at each position (budget minus tokens already emitted minus one
+    for the sample itself). Returns [B, W, C] boolean masks in the stack's
+    common compact column space: column c is admissible at position (b, w)
+    iff it is grammar-legal from ``states[b, w]`` under grammar slot
+    ``dfa_id[b]`` AND (it is EOS or its successor can still finish within
+    ``rem[b, w]`` samples). When no column is budget-finishable the mask
+    degrades to the plain legal set — same semantics as the engine's
+    single-step ``_stacked_budget_mask``, vectorised over the window, so a
+    speculative verify at position w masks exactly as sequential decode
+    would at emission index w (the greedy-parity invariant rests on this).
+
+    REFERENCE implementation: the serving path gets these masks for free
+    from the drafter's DFA walk (``speculative.draft_window`` emits the
+    mask it computed at each visited state instead of re-gathering the
+    whole window here — three [B, W, C] table gathers saved per verify).
+    Kept as the spelled-out semantics the scan-emitted masks are
+    property-tested against (tests/test_speculative.py).
+    """
+    import jax.numpy as jnp
+
+    strans, smask, sdist, _sactive, seos = sdfa_tables
+    legal = smask[dfa_id[:, None], states]  # [B, W, C]
+    succ = strans[dfa_id[:, None], states]  # [B, W, C]
+    finishable = legal & (
+        seos[dfa_id][:, None, :]
+        | (sdist[dfa_id[:, None, None], succ] <= rem[..., None])
+    )
+    feasible = jnp.any(finishable, axis=-1, keepdims=True)
+    return jnp.where(feasible, finishable, legal)
+
+
 def _validate_trie_names(names, what: str) -> list[bytes]:
     seen = set()
     out: list[bytes] = []

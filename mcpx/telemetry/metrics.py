@@ -180,6 +180,38 @@ class Metrics:
             "Shared-prefix KV entries built",
             registry=self.registry,
         )
+        # Grammar-aware speculative decoding (engine/speculative.py): how
+        # many tokens the recurrent drafter proposed and how many survived
+        # the batched verify, split by row class — constrained rows draft
+        # through their stacked grammar DFA (admissible-only proposals,
+        # forced chains accepted with certainty), free rows draft unmasked.
+        # accepted/drafted per class is the acceptance rate the design
+        # claims stays high exactly where decode is slowest.
+        self.spec_drafted = Counter(
+            "mcpx_engine_spec_drafted_total",
+            "Draft tokens proposed by the speculative decoder, by row "
+            "class (constrained = grammar-DFA pre-filtered, free = "
+            "unmasked drafter proposals)",
+            ["cls"],
+            registry=self.registry,
+        )
+        self.spec_accepted = Counter(
+            "mcpx_engine_spec_accepted_total",
+            "Draft tokens accepted by the batched verification forward "
+            "(each accepted token is one full model forward the slab did "
+            "NOT run), by row class",
+            ["cls"],
+            registry=self.registry,
+        )
+        self.spec_accept_rate = Gauge(
+            "mcpx_engine_spec_accept_rate",
+            "Running speculative accept rate (accepted/drafted) per row "
+            "class — the grammar pre-filter keeps the constrained rate "
+            "high independent of drafter quality (forced chains verify "
+            "with certainty); the free rate is all drafter",
+            ["cls"],
+            registry=self.registry,
+        )
         self.resident_grammars = Gauge(
             "mcpx_engine_resident_grammars",
             "Distinct constrained grammars resident in the decode slab "

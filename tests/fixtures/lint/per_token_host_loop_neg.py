@@ -1,0 +1,45 @@
+"""Negative fixtures: device-chained loops, non-feedback syncs, and
+non-jitted feedback must not match per-token-host-loop."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def step(state, tok):
+    return state + 1, jnp.argmax(state) + tok
+
+
+def python_step(state, tok):
+    return state, tok + 1
+
+
+def decode_device_chained(state, tok):
+    # The good pattern: the token stays a device value across iterations;
+    # ONE batched fetch after the loop.
+    toks = []
+    for _ in range(64):
+        state, tok = step(state, tok)
+        toks.append(tok)
+    return jax.device_get(toks)
+
+
+def train_metrics_only(state, batch):
+    # Per-iteration sync that is NOT fed back into the dispatch: the
+    # jit-host-sync hot-loop rule's business, not this rule's.
+    losses = []
+    for _ in range(10):
+        state, loss = step(state, batch)
+        losses.append(float(loss))
+    return losses
+
+
+def feedback_through_python_fn(state, tok):
+    # Feedback into a plain-Python helper, no jitted dispatch in the loop
+    # consuming the synced value.
+    out = []
+    while tok < 10:
+        arr = np.asarray([tok])
+        state, tok = python_step(state, int(arr[0]))
+        out.append(tok)
+    return out

@@ -1,0 +1,40 @@
+"""Positive fixtures: host loops that dispatch a jitted step, sync its
+result every iteration, and feed the synced value back into the next
+dispatch — one device round trip per token."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(state, tok):
+    return state + 1, jnp.argmax(state) + tok
+
+
+def decode_while(state, tok, eos):
+    out = []
+    while tok != eos:
+        state, logits = step(state, tok)
+        tok = int(jnp.argmax(logits))  # sync fed back into step()
+        out.append(tok)
+    return out
+
+
+def decode_for_item(state, tok):
+    toks = []
+    for _ in range(64):
+        state, logits = step(state, tok)
+        tok = logits.item()  # sync fed back into step()
+        toks.append(tok)
+    return toks
+
+
+def decode_device_get(state, tok):
+    # Even the sanctioned batched fetch serializes when it closes the
+    # feedback edge: the next dispatch cannot be enqueued until the host
+    # has the previous token in hand.
+    toks = []
+    for _ in range(8):
+        state, logits = step(state, tok)
+        tok = jax.device_get(logits)  # sync fed back into step()
+        toks.append(tok)
+    return toks

@@ -3,6 +3,7 @@
 
 from __future__ import annotations
 
+import contextlib
 from typing import Any
 
 from mcpx.orchestrator.transport import LocalTransport, TransportError
@@ -53,3 +54,40 @@ def make_transport(*services: FakeService, latencies: dict[str, float] | None = 
     for svc in services:
         transport.register(svc.name, svc, latency_s=(latencies or {}).get(svc.name, 0.0))
     return transport
+
+
+@contextlib.contextmanager
+def count_compiles(substring: str):
+    """Count XLA compiles of executables whose ``jax_log_compiles`` message
+    mentions ``substring`` — the compile-count acceptance harness shared by
+    the hetero/spec segment tests. Yields the live list of matching
+    messages; setup/teardown (the private ``jax._src.interpreters.pxla``
+    logger, the DEBUG level, the ``jax_log_compiles`` flag) lives HERE so a
+    JAX version moving those internals is a one-place fix. Imports are
+    local: transport-only test modules import helpers without paying for
+    jax."""
+    import logging
+
+    import jax
+
+    compiles: list[str] = []
+
+    class _Counter(logging.Handler):
+        def emit(self, rec):
+            msg = rec.getMessage()
+            if substring in msg and "Compiling" in msg:
+                compiles.append(msg)
+
+    logger = logging.getLogger("jax._src.interpreters.pxla")
+    handler = _Counter()
+    old_level = logger.level
+    old_flag = jax.config.jax_log_compiles
+    logger.addHandler(handler)
+    logger.setLevel(logging.DEBUG)
+    jax.config.update("jax_log_compiles", True)
+    try:
+        yield compiles
+    finally:
+        jax.config.update("jax_log_compiles", old_flag)
+        logger.removeHandler(handler)
+        logger.setLevel(old_level)

@@ -44,7 +44,9 @@ def test_worker_lever_knobs(monkeypatch):
     monkeypatch.setenv("MCPX_BENCH_DEPTH", "3")
     monkeypatch.setenv("MCPX_BENCH_MINFREE", "16")
     monkeypatch.setenv("MCPX_BENCH_WAIT", "0.05")
-    monkeypatch.setenv("MCPX_BENCH_SPEC", "4")
+    # (MCPX_BENCH_SPECULATE_K was MCPX_BENCH_SPEC until the speculative-
+    # decoding phase gate claimed that name.)
+    monkeypatch.setenv("MCPX_BENCH_SPECULATE_K", "4")
     monkeypatch.setenv("MCPX_BENCH_DRAFT", "off")
     cfg = bench._build_config("test")
     e = cfg.engine
@@ -64,7 +66,7 @@ def test_worker_lever_defaults_untouched(monkeypatch):
         "MCPX_BENCH_DEPTH",
         "MCPX_BENCH_MINFREE",
         "MCPX_BENCH_WAIT",
-        "MCPX_BENCH_SPEC",
+        "MCPX_BENCH_SPECULATE_K",
         "MCPX_BENCH_DRAFT",
     ):
         monkeypatch.delenv(env, raising=False)
@@ -73,6 +75,21 @@ def test_worker_lever_defaults_untouched(monkeypatch):
     cfg = bench._build_config("test")
     assert cfg.engine.decode_steps_per_tick == EngineConfig.decode_steps_per_tick
     assert cfg.engine.pipeline_depth == EngineConfig.pipeline_depth
+
+
+def test_spec_headline_flip(monkeypatch):
+    """MCPX_BENCH_SPEC_HEADLINE arms speculation for the headline phases
+    AND implies hetero_batch (the grammar-aware drafter only runs in the
+    heterogeneous slab); unset, both stay off for round comparability."""
+    monkeypatch.delenv("MCPX_BENCH_HETERO", raising=False)
+    monkeypatch.setenv("MCPX_BENCH_SPEC_HEADLINE", "1")
+    cfg = bench._build_config("test")
+    assert cfg.engine.speculative.enabled is True
+    assert cfg.engine.hetero_batch is True
+    monkeypatch.delenv("MCPX_BENCH_SPEC_HEADLINE", raising=False)
+    cfg = bench._build_config("test")
+    assert cfg.engine.speculative.enabled is False
+    assert cfg.engine.hetero_batch is False
 
 
 def test_fallback_kinds_scrape_is_kind_complete():

@@ -38,3 +38,50 @@ def test_invalid_page_size_rejected():
 def test_invalid_planner_kind_rejected():
     with pytest.raises(ConfigError, match="planner.kind"):
         MCPXConfig.from_dict({"planner": {"kind": "oracle"}})
+
+
+def test_nested_speculative_from_dict_roundtrip():
+    """engine.speculative is a NESTED dataclass: dict loading reaches one
+    level deeper with the same key checking and string coercion, and
+    to_dict round-trips it."""
+    cfg = MCPXConfig.from_dict(
+        {"engine": {"speculative": {"enabled": "true", "k": "6", "draft": "grammar"}}}
+    )
+    assert cfg.engine.speculative.enabled is True
+    assert cfg.engine.speculative.k == 6
+    assert cfg.engine.speculative.draft == "grammar"
+    assert cfg.to_dict()["engine"]["speculative"] == {
+        "enabled": True,
+        "k": 6,
+        "draft": "grammar",
+    }
+    with pytest.raises(ConfigError, match="engine.speculative.nope"):
+        MCPXConfig.from_dict({"engine": {"speculative": {"nope": 1}}})
+    # The natural YAML/JSON mistake `speculative: true` (the enable flag
+    # lives INSIDE the nested object) must fail as a ConfigError at load,
+    # not an AttributeError later in validate().
+    with pytest.raises(ConfigError, match="engine.speculative.*object"):
+        MCPXConfig.from_dict({"engine": {"speculative": True}})
+
+
+def test_nested_speculative_env_overrides():
+    cfg = MCPXConfig.from_env(
+        {
+            "MCPX_ENGINE_SPECULATIVE_ENABLED": "1",
+            "MCPX_ENGINE_SPECULATIVE_K": "3",
+        }
+    )
+    assert cfg.engine.speculative.enabled is True
+    assert cfg.engine.speculative.k == 3
+    assert cfg.engine.speculative.draft == "recurrent"  # untouched default
+
+
+def test_invalid_speculative_rejected():
+    with pytest.raises(ConfigError, match="speculative.k"):
+        MCPXConfig.from_dict({"engine": {"speculative": {"k": 0}}})
+    # Upper bound guards the drafter's float32 closed-form state advance
+    # (2^i per window position overflows past ~127 and NaNs acceptance).
+    with pytest.raises(ConfigError, match="speculative.k"):
+        MCPXConfig.from_dict({"engine": {"speculative": {"k": 128}}})
+    with pytest.raises(ConfigError, match="speculative.draft"):
+        MCPXConfig.from_dict({"engine": {"speculative": {"draft": "oracle"}}})

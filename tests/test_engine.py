@@ -775,28 +775,10 @@ def test_hetero_segment_compiles_once_across_grammar_mix():
     temperature triggers ZERO further XLA compiles of the hetero segment —
     temperature/constrained are device values and grammars are stacked
     table DATA, not static args."""
-    import logging
-
-    import jax
-
     from mcpx.planner.grammar import build_plan_grammar
+    from tests.helpers import count_compiles
 
-    compiles: list[str] = []
-
-    class _Counter(logging.Handler):
-        def emit(self, rec):
-            msg = rec.getMessage()
-            if "_hetero_segment_impl" in msg and "Compiling" in msg:
-                compiles.append(msg)
-
-    logger = logging.getLogger("jax._src.interpreters.pxla")
-    handler = _Counter()
-    old_level = logger.level
-    logger.addHandler(handler)
-    logger.setLevel(logging.DEBUG)
-    jax.config.update("jax_log_compiles", True)
-
-    async def go():
+    async def go(compiles):
         eng = make_engine(hetero_batch=True)
         await eng.start()
         try:
@@ -818,12 +800,8 @@ def test_hetero_segment_compiles_once_across_grammar_mix():
         finally:
             await eng.aclose()
 
-    try:
-        asyncio.run(go())
-    finally:
-        jax.config.update("jax_log_compiles", False)
-        logger.removeHandler(handler)
-        logger.setLevel(old_level)
+    with count_compiles("_hetero_segment_impl") as compiles:
+        asyncio.run(go(compiles))
 
 
 def test_hetero_grammar_slots_recycle_and_defer():

@@ -27,6 +27,7 @@ RULE_IDS = {
     "jit-host-sync",
     "traced-control-flow",
     "jit-static-branch",
+    "per-token-host-loop",
     "broad-except",
     "blank-lines",
     "unbounded-retry-loop",
@@ -87,6 +88,21 @@ def test_jit_static_branch_negative():
     # static_argnames branches, `is not None` presence checks, nested-def
     # shadowing and never-jitted helpers all stay silent.
     assert hits("jit_static_branch_neg.py", "jit-static-branch") == []
+
+
+def test_per_token_host_loop_positive():
+    # while + int(), for + .item(), for + device_get — each a per-iteration
+    # sync whose result feeds the next jitted dispatch (device_get IS
+    # flagged here, unlike jit-host-sync's loop mode: the feedback edge,
+    # not the fetch, is the serialization).
+    assert hits("per_token_host_loop_pos.py", "per-token-host-loop") == [17, 26, 38]
+
+
+def test_per_token_host_loop_negative():
+    # Device-chained loops with one post-loop fetch, metrics-only syncs
+    # (jit-host-sync's business) and feedback through plain-Python helpers
+    # stay silent.
+    assert hits("per_token_host_loop_neg.py", "per-token-host-loop") == []
 
 
 def test_committed_baseline_is_empty():

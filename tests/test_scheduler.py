@@ -347,6 +347,11 @@ def test_engine_queue_stats_surface():
         "depth_free": 0,
         "hol_wait_ms": 0.0,
         "resident_grammars": 0,
+        # Speculative-decoding additions: accept rates, zero until the
+        # drafter has proposed anything.
+        "spec_accept_rate": 0.0,
+        "spec_accept_rate_constrained": 0.0,
+        "spec_accept_rate_free": 0.0,
     }
     eng._ewma_service_s = 2.0
     for _ in range(5):  # 4 fit the free slab rows; 1 overflows = 1 drain

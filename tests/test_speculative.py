@@ -1,0 +1,469 @@
+"""Grammar-aware speculative decoding (ISSUE 6): drafter + one-forward
+verification in the heterogeneous slab. The invariants pinned here:
+
+  - off = byte-identical pass-through (the repo's config-gated-subsystem
+    convention) and the spec executable is never even dispatched;
+  - on  = greedy outputs byte-identical to off (the sequential-sample
+    accept rule is exact) while doing strictly fewer model forwards;
+  - constrained rows can NEVER emit a DFA-inadmissible token under
+    speculation, whatever the grammar or temperature (property test over
+    seeded grammars);
+  - one compile serves every resident-grammar × accept-pattern mix;
+  - stacked-DFA slot recycling survives rows retiring with different
+    accepted lengths.
+"""
+
+import asyncio
+
+from mcpx.core.config import MCPXConfig
+from mcpx.engine.engine import InferenceEngine
+from mcpx.planner.grammar import build_plan_grammar
+
+
+def make_engine(**engine_overrides):
+    cfg = MCPXConfig.from_dict(
+        {
+            "model": {"size": "test", "max_seq_len": 256},
+            "engine": {
+                "use_pallas": False,  # jnp reference attention on CPU
+                "max_batch_size": 4,
+                "max_decode_len": 96,
+                "kv_page_size": 16,
+                "max_pages_per_seq": 16,
+                "temperature": 0.0,
+                **engine_overrides,
+            },
+        }
+    )
+    return InferenceEngine(cfg)
+
+
+def spec_engine(**spec):
+    return make_engine(
+        hetero_batch=True, speculative={"enabled": True, "k": 4, **spec}
+    )
+
+
+def _spec_counters(eng):
+    drafted = sum(
+        eng.metrics.spec_drafted.labels(cls=c)._value.get()
+        for c in ("constrained", "free")
+    )
+    accepted = sum(
+        eng.metrics.spec_accepted.labels(cls=c)._value.get()
+        for c in ("constrained", "free")
+    )
+    return drafted, accepted
+
+
+def test_spec_off_is_passthrough_parity():
+    """speculative.enabled=false is a byte-identical pass-through of the
+    legacy hetero decode: same outputs as an engine that never heard of
+    the subsystem, zero drafted tokens, spec executable never dispatched."""
+
+    async def go():
+        eng_legacy = make_engine(hetero_batch=True)
+        eng_off = make_engine(
+            hetero_batch=True, speculative={"enabled": False, "k": 4}
+        )
+        await eng_legacy.start()
+        await eng_off.start()
+        try:
+            tok = eng_legacy.tokenizer
+            for text, budget in [
+                ("plan: compose the services. JSON:", 48),
+                ("q", 24),
+            ]:
+                a = await eng_legacy.generate(tok.encode(text), max_new_tokens=budget)
+                b = await eng_off.generate(tok.encode(text), max_new_tokens=budget)
+                assert a.text == b.text, (text, a.text, b.text)
+            free_a = await eng_legacy.generate(
+                tok.encode("free"), max_new_tokens=8, constrained=False
+            )
+            free_b = await eng_off.generate(
+                tok.encode("free"), max_new_tokens=8, constrained=False
+            )
+            assert free_a.token_ids == free_b.token_ids
+            assert _spec_counters(eng_off) == (0.0, 0.0)
+            qs = eng_off.queue_stats()
+            assert qs["spec_accept_rate"] == 0.0
+        finally:
+            await eng_legacy.aclose()
+            await eng_off.aclose()
+
+    asyncio.run(go())
+
+
+def test_spec_on_greedy_matches_spec_off():
+    """The accept rule is exact: greedy outputs are byte-identical with
+    speculation on vs off — across budgets, prompts and a registry-trie
+    grammar — while the spec engine drafts, accepts, and does strictly
+    fewer model forwards than tokens emitted."""
+
+    async def go():
+        eng_off = make_engine(hetero_batch=True)
+        eng_on = spec_engine()
+        await eng_off.start()
+        await eng_on.start()
+        try:
+            tok = eng_off.tokenizer
+            names = ["svc-alpha", "svc-beta", "rank-gamma"]
+            g_off = build_plan_grammar(eng_off.tokenizer, names)
+            g_on = build_plan_grammar(eng_on.tokenizer, names)
+            prompts = ["plan: compose the services. JSON:", "q"]
+            budgets = [eng_off.grammar.min_len, 24, 96]
+            for text in prompts:
+                for budget in budgets:
+                    a = await eng_off.generate(
+                        tok.encode(text), max_new_tokens=budget
+                    )
+                    b = await eng_on.generate(
+                        tok.encode(text), max_new_tokens=budget
+                    )
+                    assert a.text == b.text, (text, budget, a.text, b.text)
+            a = await eng_off.generate(
+                tok.encode("trie plan. JSON:"), max_new_tokens=48, grammar=g_off
+            )
+            b = await eng_on.generate(
+                tok.encode("trie plan. JSON:"), max_new_tokens=48, grammar=g_on
+            )
+            assert a.text == b.text
+            # Free-form greedy rows: the drafter proposes unmasked, and the
+            # full-window verification argmax must reproduce the legacy
+            # last-position path token for token.
+            fa = await eng_off.generate(
+                tok.encode("free greedy"), max_new_tokens=12, constrained=False
+            )
+            fb = await eng_on.generate(
+                tok.encode("free greedy"), max_new_tokens=12, constrained=False
+            )
+            assert fa.token_ids == fb.token_ids
+            drafted, accepted = _spec_counters(eng_on)
+            assert drafted > 0 and accepted > 0
+            fwd = eng_on.metrics.decode_forwards._value.get()
+            toks = eng_on.metrics.decode_tokens._value.get()
+            assert fwd < toks, (
+                f"speculation did not amortise: {fwd} forwards / {toks} tokens"
+            )
+            qs = eng_on.queue_stats()
+            assert 0.0 < qs["spec_accept_rate_constrained"] <= 1.0
+        finally:
+            await eng_off.aclose()
+            await eng_on.aclose()
+
+    asyncio.run(go())
+
+
+def test_spec_grammar_draft_mode_exact():
+    """draft='grammar' (forced-successor drafting only, zero drafter
+    compute) is equally exact under greedy decode and still amortises on
+    plan JSON (single-successor chains draft themselves)."""
+
+    async def go():
+        eng_off = make_engine(hetero_batch=True)
+        eng_on = spec_engine(draft="grammar")
+        await eng_off.start()
+        await eng_on.start()
+        try:
+            tok = eng_off.tokenizer
+            p = tok.encode("plan: compose. JSON:")
+            a = await eng_off.generate(p, max_new_tokens=48)
+            b = await eng_on.generate(p, max_new_tokens=48)
+            assert a.text == b.text
+            drafted, accepted = _spec_counters(eng_on)
+            # Forced drafts verify with certainty: everything drafted in
+            # grammar mode must have been accepted.
+            assert drafted > 0
+            assert accepted == drafted
+            assert (
+                eng_on.metrics.decode_forwards._value.get()
+                < eng_on.metrics.decode_tokens._value.get()
+            )
+        finally:
+            await eng_off.aclose()
+            await eng_on.aclose()
+
+    asyncio.run(go())
+
+
+def test_spec_constrained_rows_never_emit_inadmissible():
+    """Property over seeded grammars: whatever the registry trie and
+    whatever the temperature, a constrained row under speculation only
+    ever emits legal DFA prefixes — accepted drafts are admissible by
+    construction and the correction is sampled under the same mask."""
+    import random
+
+    async def go():
+        eng = spec_engine()
+        await eng.start()
+        try:
+            tok = eng.tokenizer
+            for seed in range(4):
+                rng = random.Random(seed)
+                names = [
+                    f"{rng.choice(['data', 'rank', 'sum'])}-"
+                    f"{rng.choice(['etl', 'ml', 'api'])}-{rng.randrange(100):02d}"
+                    for _ in range(rng.randrange(2, 6))
+                ]
+                g = build_plan_grammar(tok, sorted(set(names)))
+                results = await asyncio.gather(
+                    *(
+                        eng.generate(
+                            tok.encode(f"seeded plan {seed}-{i}. JSON:"),
+                            max_new_tokens=rng.choice([g.min_len, 24, 48]),
+                            temperature=t,
+                            grammar=g,
+                        )
+                        for i, t in enumerate([0.0, 0.9, 0.0, 1.3])
+                    )
+                )
+                for r in results:
+                    state = g.walk(r.text)
+                    assert state != g.dead_state, (seed, r.text)
+            drafted, _ = _spec_counters(eng)
+            assert drafted > 0
+            assert eng._allocator.stats().sequences == 0
+            eng._allocator.check_invariants()
+        finally:
+            await eng.aclose()
+
+    asyncio.run(go())
+
+
+def test_spec_segment_compiles_once_across_grammar_mix():
+    """Executable-count acceptance: the fixed [rows, K+1] window means ONE
+    spec-segment compile serves every resident-grammar combination, accept
+    pattern, temperature and constrained/free mix."""
+    from tests.helpers import count_compiles
+
+    async def go(compiles):
+        eng = spec_engine()
+        await eng.start()
+        try:
+            p = eng.tokenizer.encode("plan: compose. JSON:")
+            await eng.generate(p, max_new_tokens=24)
+            n0 = len(compiles)
+            assert n0 >= 1, "first spec segment never compiled?"
+            g1 = build_plan_grammar(eng.tokenizer, ["svc-a", "svc-b"])
+            g2 = build_plan_grammar(eng.tokenizer, ["other-x", "other-y"])
+            await asyncio.gather(
+                eng.generate(p, max_new_tokens=24, grammar=g1),
+                eng.generate(p, max_new_tokens=24, grammar=g2, temperature=0.7),
+                eng.generate(
+                    eng.tokenizer.encode("free"), max_new_tokens=8, constrained=False
+                ),
+            )
+            assert len(compiles) == n0, (
+                f"spec segment recompiled for new grammars/configs/accept "
+                f"patterns: {len(compiles) - n0} extra compiles"
+            )
+        finally:
+            await eng.aclose()
+
+    with count_compiles("_hetero_segment_spec_impl") as compiles:
+        asyncio.run(go(compiles))
+
+
+def test_spec_slot_recycle_with_mixed_accepted_lengths():
+    """Slot recycling under speculation: rows retiring with DIFFERENT
+    accepted lengths (two grammars through 2 slots, a free row, a hot row)
+    release their stacked-DFA slots and pages cleanly, and the overflow
+    grammar still defers-then-completes."""
+
+    async def go():
+        eng = make_engine(
+            hetero_batch=True,
+            hetero_grammar_slots=2,
+            speculative={"enabled": True, "k": 4},
+        )
+        await eng.start()
+        try:
+            tok = eng.tokenizer
+            p = tok.encode("plan: q. JSON:")
+            g1 = build_plan_grammar(tok, ["aaa-svc"])
+            g2 = build_plan_grammar(tok, ["bbb-svc-with-a-much-longer-name"])
+            r1, r2, r3, r4 = await asyncio.gather(
+                eng.generate(p, max_new_tokens=32, grammar=g1),
+                eng.generate(p, max_new_tokens=64, grammar=g2),
+                eng.generate(tok.encode("free"), max_new_tokens=8, constrained=False),
+                eng.generate(p, max_new_tokens=24, temperature=0.9),
+            )
+            assert '"s":"aaa-svc"' in r1.text
+            assert '"s":"bbb-svc-with-a-much-longer-name"' in r2.text
+            assert eng.grammar.walk(r4.text) != eng.grammar.dead_state
+            assert eng.queue_stats()["resident_grammars"] == 0
+            assert all(n == 0 for n in eng._dfa_slot_refs)
+            assert eng._allocator.stats().sequences == 0
+            eng._allocator.check_invariants()
+        finally:
+            await eng.aclose()
+
+    asyncio.run(go())
+
+
+def test_spec_live_flip_off_keeps_latched_geometry():
+    """A live `speculative.enabled` flip-off while spec-admitted rows are
+    resident must not retrace: dispatch reads the slab's LATCHED spec_k /
+    spec_draft, never the live config (an unwarmed K=0 executable compiled
+    mid-serving is exactly the stall the latch contract forbids). Requests
+    before, during, and after the flip all complete correctly, and no new
+    spec-segment compile ever happens."""
+    from tests.helpers import count_compiles
+
+    async def go(compiles):
+        eng = spec_engine()
+        await eng.start()
+        try:
+            tok = eng.tokenizer
+            p = tok.encode("plan: compose. JSON:")
+            await eng.generate(p, max_new_tokens=24)  # prime the executable
+            n0 = len(compiles)
+
+            async def flip_then_request():
+                await asyncio.sleep(0.05)  # land while rows are resident
+                eng.config.engine.speculative.enabled = False
+                return await eng.generate(p, max_new_tokens=24)
+
+            r1, r2 = await asyncio.gather(
+                eng.generate(p, max_new_tokens=96), flip_then_request()
+            )
+            r3 = await eng.generate(p, max_new_tokens=24)
+            for r in (r1, r2, r3):
+                assert eng.grammar.walk(r.text) != eng.grammar.dead_state
+            assert len(compiles) == n0, (
+                f"live flip-off retraced the spec segment "
+                f"({len(compiles) - n0} extra compiles)"
+            )
+            assert eng._slab.n_active == 0
+        finally:
+            await eng.aclose()
+
+    with count_compiles("_hetero_segment_spec_impl") as compiles:
+        asyncio.run(go(compiles))
+
+
+def test_stacked_window_admissibility_matches_draft_walk_masks():
+    """Property over seeded grammars: the verify-window masks the drafter's
+    DFA walk emits (``draft_window``, gathered at the states it visits)
+    equal the spelled-out reference ``stacked_window_admissibility`` at
+    every position verification can consume — position 0, the unbroken
+    proposal prefix, and the correction slot — across start states,
+    mid-plan trie interiors, a free row, both draft modes, and a budget
+    horizon tight enough that the finishability mask binds (the
+    degrade-to-legal path)."""
+    import random
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mcpx.engine.speculative import draft_window
+    from mcpx.models.tokenizer import ByteTokenizer
+    from mcpx.planner.grammar import (
+        build_trivial_grammar,
+        stacked_spec_tables,
+        stacked_tables,
+        stacked_window_admissibility,
+    )
+
+    tok = ByteTokenizer()
+    K = 4
+    rng = random.Random(7)
+    nprng = np.random.default_rng(7)
+    names1 = sorted({f"svc-{rng.randrange(100):02d}" for _ in range(3)})
+    names2 = sorted({f"rank-{rng.choice(['etl', 'ml'])}" for _ in range(2)})
+    g1 = build_plan_grammar(tok, names1)
+    g2 = build_plan_grammar(tok, names2)
+    slots = [build_trivial_grammar(tok), g1, g2]
+    strans, smask, sdist, sactive, seos = stacked_tables(slots, 512)
+    sdist_succ, _inv = stacked_spec_tables(slots, 512)
+    sdfa = tuple(
+        jnp.asarray(t) for t in (strans, smask, sdist_succ, sactive, seos)
+    )
+    ref_tables = tuple(
+        jnp.asarray(t) for t in (strans, smask, sdist, sactive, seos)
+    )
+
+    rows = []  # (grammar slot, DFA state, emitted, constrained)
+    for gi, g, name in ((1, g1, names1[0]), (2, g2, names2[0])):
+        plan = '{"steps":[{"s":"%s","in":[],"next":[]}]}' % name
+        for cut in (0, 1, 8, 12, 14, len(plan) - 4):
+            st = g.walk(plan[:cut])
+            assert st != g.dead_state
+            rows.append((gi, st, cut, True))
+    rows.append((0, slots[0].start_state, 5, False))  # free row
+    B = len(rows)
+    dfa_id = jnp.asarray([r[0] for r in rows], jnp.int32)
+    st = jnp.asarray([r[1] for r in rows], jnp.int32)
+    emitted = jnp.asarray([r[2] for r in rows], jnp.int32)
+    cons_v = jnp.asarray([r[3] for r in rows])
+    done = jnp.zeros((B,), bool)
+    H = 16
+    embed = jnp.asarray(
+        nprng.normal(size=(tok.vocab_size, H)), jnp.float32
+    )
+    cur = jnp.full((B,), tok.encode("{")[0], jnp.int32)
+    hstate = jnp.zeros((B, H), jnp.float32)
+    free_mask = (
+        jnp.ones((tok.vocab_size,), bool)
+        .at[tok.eos_id]
+        .set(False)
+        .at[tok.pad_id]
+        .set(False)
+    )
+
+    for slack, mode in [(48, "recurrent"), (6, "recurrent"), (48, "grammar")]:
+        budgets = emitted + slack
+        _p_toks, p_use, s_before, s_fin, masks = draft_window(
+            embed,
+            sdfa,
+            dfa_id,
+            st,
+            cur,
+            hstate,
+            emitted,
+            budgets,
+            done,
+            cons_v,
+            free_mask,
+            tok.pad_id,
+            k=K,
+            mode=mode,
+        )
+        states = jnp.concatenate([s_before, s_fin[:, None]], axis=1)
+        rem = (
+            budgets[:, None]
+            - (emitted[:, None] + jnp.arange(K + 1)[None, :])
+            - 1
+        )
+        ref = stacked_window_admissibility(ref_tables, dfa_id, states, rem)
+        # Positions verification can consume: j=0 always (its mask was
+        # gathered before any proposal could stop), j>0 while every prior
+        # step proposed (a stopped row's later slots repeat its frozen
+        # state/budget — out of the comparison by the stop bound).
+        prefix_ok = jnp.cumprod(p_use.astype(jnp.int32), axis=1).astype(bool)
+        valid = np.asarray(
+            jnp.concatenate([jnp.ones((B, 1), bool), prefix_ok], axis=1)
+        )
+        m, r = np.asarray(masks), np.asarray(ref)
+        assert (m[valid] == r[valid]).all(), (mode, slack)
+        assert valid.sum() > B  # chains actually formed; not a vacuous pass
+
+
+def test_spec_without_hetero_serves_legacy():
+    """speculative.enabled without hetero_batch: the engine warns and
+    serves the legacy path (no drafting, no behavior change) — config
+    mistakes degrade loudly, never corrupt decode."""
+
+    async def go():
+        eng = make_engine(speculative={"enabled": True, "k": 4})
+        await eng.start()
+        try:
+            res = await eng.generate(
+                eng.tokenizer.encode("plan: compose. JSON:"), max_new_tokens=24
+            )
+            assert eng.grammar.walk(res.text) != eng.grammar.dead_state
+            assert _spec_counters(eng) == (0.0, 0.0)
+        finally:
+            await eng.aclose()
+
+    asyncio.run(go())
