@@ -28,6 +28,16 @@ Prints ONE JSON line:
 vs_baseline is against the north-star target of 100 plans/sec (BASELINE.md;
 the reference publishes no numbers of its own, SURVEY.md §6).
 
+The output also carries the roofline cost observatory (ISSUE 7,
+docs/observability.md): a per-phase ``roofline`` block from GET /costs
+deltas (XLA cost_analysis — achieved FLOP/s, bytes/s, arithmetic
+intensity, mfu vs device peaks; ``mfu_basis="xla_cost_analysis"`` where
+the backend publishes costs, labeled fallback otherwise), ``pallas_reason``
+(why the Pallas kernel path is off, next to the ``pallas`` flag), and a
+``regression`` verdict of this run against the committed BENCH_r*.json
+series (mcpx/cli/bench_report.py — the same report `mcpx bench report`
+computes offline).
+
 Environment knobs:
     MCPX_BENCH_MODEL     model size ("2b" default on TPU, "test" on CPU)
     MCPX_BENCH_BATCH     engine max_batch_size (default 64; lower on HBM OOM)
@@ -169,6 +179,78 @@ def _measured_peak_flops() -> float:
 class BenchGateError(RuntimeError):
     """Honesty-gate failure (llm_share, error rate): must FAIL the bench,
     never be swallowed by the model-size fallback retry."""
+
+
+def _roofline_block(
+    costs0,
+    costs1,
+    costs2,
+    sat_wall: float,
+    open_wall: float,
+    peak_flops: "float | None",
+    peak_flops_basis: "str | None",
+    peak_bytes: "float | None",
+    mfu_analytic: "float | None",
+    analytic_flops: float,
+) -> dict:
+    """Per-phase roofline from GET /costs snapshots (XLA cost_analysis
+    totals, mcpx/telemetry/costs.py): achieved FLOP/s, achieved bytes/s,
+    arithmetic intensity and position against the device peaks, for the
+    saturation and open-loop phases. ``basis`` labels whether the numbers
+    are XLA-derived or the accounting was unavailable (scrape failed, cost
+    analysis unsupported) — never silently absent. The analytic
+    2·params·tokens model rides along as a cross-check: ``xla_vs_analytic``
+    is XLA-counted phase flops over the analytic bill, so a drifting ratio
+    says the analytic model is mis-billing (attention, drafter, padding)."""
+    # stdlib-safe: rounded_roofline touches no jax. One precision contract
+    # with the engine's span attrs (costs._ROOFLINE_ROUNDING).
+    from mcpx.telemetry.costs import rounded_roofline
+
+    def totals(c):
+        if not isinstance(c, dict):
+            return None
+        return (c.get("engine") or {}).get("totals")
+
+    def phase(c_lo, c_hi, wall):
+        t_lo, t_hi = totals(c_lo), totals(c_hi)
+        if t_lo is None or t_hi is None or wall <= 0:
+            return None
+        df = (t_hi.get("flops_executed") or 0.0) - (t_lo.get("flops_executed") or 0.0)
+        db = (t_hi.get("bytes_executed") or 0.0) - (t_lo.get("bytes_executed") or 0.0)
+        if df <= 0:
+            return None
+        rl = rounded_roofline(
+            df, db or None, wall, peak_flops=peak_flops, peak_bytes_s=peak_bytes
+        )
+        return {
+            "flops": df,
+            "bytes_accessed": db,
+            "wall_s": round(wall, 3),
+            "achieved_flops_s": rl.get("achieved_flops_s"),
+            "achieved_bytes_s": rl.get("achieved_bytes_s"),
+            "arithmetic_intensity": rl.get("arithmetic_intensity"),
+            "mfu": rl.get("mfu"),
+            "hbm_bw_util": rl.get("hbm_bw_util"),
+            "bound": rl.get("bound"),
+        }
+
+    sat = phase(costs0, costs1, sat_wall)
+    open_ = phase(costs1, costs2, open_wall)
+    basis = "xla_cost_analysis" if sat is not None else "unavailable"
+    return {
+        "basis": basis,
+        "mfu_basis": basis,
+        "peak_flops": peak_flops,
+        "peak_flops_basis": peak_flops_basis,
+        "peak_bytes_s": peak_bytes,
+        "phases": {"sat": sat, "open": open_},
+        "mfu_analytic": round(mfu_analytic, 6) if mfu_analytic is not None else None,
+        "xla_vs_analytic": (
+            round(sat["flops"] / analytic_flops, 4)
+            if sat is not None and analytic_flops > 0
+            else None
+        ),
+    }
 
 
 def _sp_bench_model(n_pieces: int) -> str:
@@ -1391,8 +1473,20 @@ async def _run(model_size: str, n_requests: int, concurrency: int, n_services: i
             warmup_s = time.monotonic() - t_setup0
             origins.clear()
 
+            async def get_costs():
+                # Roofline cost observatory scrape (GET /costs): XLA-derived
+                # executed-work totals whose phase deltas become the output
+                # JSON's roofline block. Best-effort — a failed scrape
+                # degrades the block to basis="unavailable", never the run.
+                try:
+                    async with session.get(f"{base}/costs") as resp:
+                        return await resp.json()
+                except Exception:  # noqa: BLE001 - accounting must not fail the bench
+                    return None
+
             async with session.get(f"{base}/metrics") as resp:
                 prom0 = _parse_prom(await resp.text())
+            costs0 = await get_costs()
 
             # ---- Phase 1: closed-loop saturation -> plans/sec
             sat_lat: list[float] = []
@@ -1414,6 +1508,7 @@ async def _run(model_size: str, n_requests: int, concurrency: int, n_services: i
 
             async with session.get(f"{base}/metrics") as resp:
                 prom1 = _parse_prom(await resp.text())
+            costs1 = await get_costs()
 
             # ---- Phase 2: open-loop latency at a fraction of measured throughput
             rate_frac = float(os.environ.get("MCPX_BENCH_RATE_FRACTION", "0.7"))
@@ -1428,12 +1523,14 @@ async def _run(model_size: str, n_requests: int, concurrency: int, n_services: i
                     errors += 1
                 open_lat.append(ms)
 
+            t_open0 = time.monotonic()
             await asyncio.gather(
                 *(
                     one_open(intent, i / rate)
                     for i, intent in enumerate(intents[n_requests:])
                 )
             )
+            open_elapsed = time.monotonic() - t_open0
 
             # Open-loop phase scrape: the phase split that matters for the p50
             # target is THIS phase's (queue under Little's law in the closed
@@ -1441,6 +1538,7 @@ async def _run(model_size: str, n_requests: int, concurrency: int, n_services: i
             # and sat_p50_ms are separate headline fields).
             async with session.get(f"{base}/metrics") as resp:
                 prom2 = _parse_prom(await resp.text())
+            costs2 = await get_costs()
 
         # ---- Quality sample: are served plans on-intent? (VERDICT r3 weak #4)
         # A separate small loop AFTER the timed phases so per-response scoring
@@ -1557,24 +1655,58 @@ async def _run(model_size: str, n_requests: int, concurrency: int, n_services: i
         )
     goodput_flops = model_flops / max(1e-9, elapsed)
     peak = _peak_flops_per_chip() if _on_tpu() else None
-    if peak is not None:
-        import jax
+    import jax
 
-        # The engine spans every visible chip by default (auto mesh), so the
-        # peak is per-chip x chips actually meshed.
-        n_chips = engine._mesh.devices.size if engine is not None and engine._mesh is not None else len(jax.devices())
-        mfu = goodput_flops / (peak * n_chips)
-        mfu_basis = "datasheet"
+    # The engine spans every visible chip by default (auto mesh), so the
+    # peak is per-chip x chips actually meshed.
+    n_chips = (
+        engine._mesh.devices.size
+        if engine is not None and engine._mesh is not None
+        else len(jax.devices())
+    )
+    if peak is not None:
+        peak_flops_total = peak * n_chips
+        peak_flops_basis = "datasheet"
     else:
         # Unknown hardware / CPU proxy: no datasheet peak, but a null MFU
         # hides whether a change moved achieved FLOPs at all (the honest-
         # progress prerequisite for the ragged-kernel roadmap item). Use a
         # MEASURED dense-matmul peak of this backend as the denominator —
-        # labeled mfu_basis="measured_matmul" so the number is never read
-        # as a datasheet fraction. One host = one "chip" here (the virtual
-        # CPU mesh shares the same silicon).
-        mfu = goodput_flops / max(1.0, _measured_peak_flops())
-        mfu_basis = "measured_matmul"
+        # labeled "measured_matmul" so the number is never read as a
+        # datasheet fraction. One host = one "chip" here (the virtual CPU
+        # mesh shares the same silicon).
+        peak_flops_total = max(1.0, _measured_peak_flops())
+        peak_flops_basis = "measured_matmul"
+    mfu_analytic = goodput_flops / peak_flops_total
+    # HBM bandwidth peak: datasheet only (no honest CPU-proxy equivalent).
+    peak_bytes_total = None
+    if _on_tpu():
+        try:
+            from mcpx.telemetry.costs import device_peaks
+
+            pk = device_peaks()
+            if pk.get("hbm_bytes_s_per_chip"):
+                peak_bytes_total = pk["hbm_bytes_s_per_chip"] * n_chips
+        except Exception:  # noqa: BLE001 - peaks are telemetry, never fatal
+            pass
+    # Roofline block (ISSUE 7 tentpole): the headline MFU is XLA-derived
+    # (cost_analysis totals over the timed phase) wherever the backend
+    # publishes costs; the analytic 2·params·tokens model stays as a
+    # cross-check inside the block (xla_vs_analytic divergence).
+    roofline_block = _roofline_block(
+        costs0, costs1, costs2, elapsed, open_elapsed,
+        peak_flops_total, peak_flops_basis, peak_bytes_total,
+        mfu_analytic=mfu_analytic, analytic_flops=model_flops,
+    )
+    sat_rl = (roofline_block.get("phases") or {}).get("sat")
+    if sat_rl is not None and sat_rl.get("mfu") is not None:
+        mfu = sat_rl["mfu"]
+        mfu_basis = "xla_cost_analysis"
+    else:
+        # Labeled fallback: the pre-observatory analytic path, with its
+        # round-comparable basis labels.
+        mfu = mfu_analytic
+        mfu_basis = "datasheet" if peak is not None else "measured_matmul"
 
     sat_sorted = sorted(sat_lat)
     open_sorted = sorted(open_lat) or [float("nan")]  # latency phase may be skipped
@@ -1582,6 +1714,11 @@ async def _run(model_size: str, n_requests: int, concurrency: int, n_services: i
 
     return {
         "backend": jax.default_backend(),
+        # Echoed into the output JSON by _output_json: the values this run
+        # ACTUALLY used (n_services is a regression-report scenario key —
+        # re-deriving it from env defaults there could mis-bucket the run).
+        "n_services": n_services,
+        "n_requests": n_requests,
         # Scheduler overload scenario (None when skipped): shed-rate,
         # degraded-share, admitted p50 vs the configured SLO at >= 4x the
         # measured sustainable rate.
@@ -1638,6 +1775,21 @@ async def _run(model_size: str, n_requests: int, concurrency: int, n_services: i
         "prefill_tokens": prefill_tokens,
         "mfu": mfu,
         "mfu_basis": mfu_basis,
+        # Per-phase XLA roofline (achieved FLOP/s, bytes/s, arithmetic
+        # intensity, position vs device peaks) + analytic cross-check —
+        # basis="unavailable" when the backend publishes no costs.
+        "roofline": roofline_block,
+        # Why the Pallas kernel path is (not) serving, readable from the
+        # JSON alone — platform / operator override / smoke evidence /
+        # engine hardware probe. pallas_effective is the engine's RESOLVED
+        # kernel path (the probe's verdict), which the output's `pallas`
+        # flag reports so flag and reason can never contradict.
+        "pallas_reason": _pallas_reason(getattr(engine, "_use_pallas", None)),
+        "pallas_effective": (
+            bool(engine._use_pallas)
+            if engine is not None and getattr(engine, "_use_pallas", None) is not None
+            else None
+        ),
         # Plan-cache accounting for repeat-intent runs (hit share over the
         # timed phase; 0.0 in the default cache-busting workload).
         "cache_hit_share": (
@@ -1757,6 +1909,33 @@ def _pallas_on() -> bool:
     return bool(_smoke_artifact().get("pallas", True))
 
 
+def _pallas_reason(engine_use_pallas: "bool | None" = None) -> str:
+    """WHY the headline serves (or doesn't serve) the Pallas paged-attention
+    kernel, so ``pallas=false`` is diagnosable from the output JSON alone:
+    platform, operator override, smoke-artifact evidence, or the engine's
+    own hardware probe (``engine_use_pallas`` = the live engine's resolved
+    ``_use_pallas``, when available)."""
+    if not _on_tpu():
+        return (
+            "cpu backend: Mosaic TPU kernels cannot run — the fused-jnp "
+            "reference attention serves"
+        )
+    env = os.environ.get("MCPX_BENCH_PALLAS")
+    if env == "0":
+        return "MCPX_BENCH_PALLAS=0: operator forced the fused-jnp path"
+    if env is None and not _smoke_artifact().get("pallas", True):
+        return (
+            "benchmarks/smoke_tpu.json: the last hardware-proven bring-up "
+            "served fused-jnp only"
+        )
+    if engine_use_pallas is False:
+        return (
+            "engine probe: head_dim % 128 != 0 — Mosaic lane tiling rejects "
+            "the paged kernel on hardware (fused-jnp served)"
+        )
+    return "enabled"
+
+
 def _on_tpu() -> bool:
     import jax
 
@@ -1868,10 +2047,31 @@ def main() -> None:
                   file=sys.stderr)
             quality_trained = {"error": f"{type(e).__name__}: {e}"}
 
+    print(json.dumps(_output_json(stats, quality_trained, model)))
+
+
+def _regression_block(out: dict) -> dict:
+    """The scenario-keyed regression verdict of THIS run against the
+    committed BENCH_r*.json series (mcpx/cli/bench_report.py — the same
+    report ``mcpx bench report`` computes offline), embedded so each new
+    artifact carries its own verdict."""
+    try:
+        from mcpx.cli.bench_report import build_report, default_series, load_runs
+
+        series = load_runs(
+            default_series(os.path.dirname(os.path.abspath(__file__)))
+        )
+        return build_report(series, current=out)
+    except Exception as e:  # noqa: BLE001 - the verdict must never kill the artifact
+        return {"verdict": "error", "error": f"{type(e).__name__}: {e}"}
+
+
+def _output_json(stats: dict, quality_trained, model: str) -> dict:
+    """The one JSON line the bench prints — schema-gated by
+    tests/test_bench_schema.py so later PRs can't silently drop fields
+    (roofline block, pallas_reason, regression verdict included)."""
     value = round(stats["plans_per_sec"], 2)
-    print(
-        json.dumps(
-            {
+    out = {
                 "metric": "plans_per_sec",
                 "value": value,
                 "unit": "plans/s",
@@ -1909,13 +2109,29 @@ def main() -> None:
                 ),
                 "model": model,
                 "batch": _bench_batch(model),
-                "pallas": _pallas_on(),
+                # The engine's RESOLVED kernel path when known (the
+                # head_dim hardware probe can veto a requested Pallas
+                # config), else the env/smoke resolution — so the flag
+                # can never contradict pallas_reason below.
+                "pallas": (
+                    bool(stats["pallas_effective"])
+                    if stats.get("pallas_effective") is not None
+                    else _pallas_on()
+                ),
+                # Satellite (ISSUE 7): pallas=false is diagnosable from the
+                # JSON alone — platform / override / smoke / engine probe.
+                "pallas_reason": stats.get("pallas_reason") or _pallas_reason(),
+                # Tentpole (ISSUE 7): per-phase XLA roofline + analytic
+                # cross-check; basis labels fall back, never vanish.
+                "roofline": stats.get("roofline")
+                or {"basis": "unavailable", "mfu_basis": "unavailable",
+                    "phases": {"sat": None, "open": None}},
                 "vocab": os.environ.get("MCPX_BENCH_VOCAB", "bpe"),
                 "quantize": os.environ.get("MCPX_BENCH_QUANTIZE", "none"),
                 "registry": os.environ.get("MCPX_BENCH_REGISTRY", "synthetic"),
                 "backend": stats["backend"],
-                "n_services": n_services,
-                "requests": n_requests,
+                "n_services": stats["n_services"],
+                "requests": stats["n_requests"],
                 "errors": stats["errors"],
                 "overload": stats["overload"],
                 "mixed": stats["mixed"],
@@ -1952,9 +2168,12 @@ def main() -> None:
                 "grammar_fallback": stats["grammar_fallback"],
                 "cache_hit_share": round(stats["cache_hit_share"], 4),
                 "unique_intents": stats["unique_intents"],
-            }
-        )
-    )
+    }
+    # Regression tracking (ISSUE 7 tentpole): the artifact carries its own
+    # verdict against the committed series — appended last so the verdict
+    # judges the final field values above.
+    out["regression"] = _regression_block(out)
+    return out
 
 
 if __name__ == "__main__":

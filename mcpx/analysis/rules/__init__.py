@@ -5,6 +5,7 @@ new rule — see docs/static-analysis.md."""
 from mcpx.analysis.rules import (  # noqa: F401
     async_rules,
     jax_rules,
+    metrics_rules,
     resilience_rules,
     style_rules,
     tracing_rules,

@@ -261,6 +261,16 @@ class TelemetryConfig:
     # every peer's, so replicas plan with shared live telemetry.
     redis_url: str = ""
     mirror_interval_s: float = 2.0
+    # Per-executable XLA cost accounting + retrace sentinel
+    # (mcpx/telemetry/costs.py, docs/observability.md): every jitted engine
+    # executable's calls are signature-tracked (dispatch itself stays the
+    # untouched jit fast path); compiles increment
+    # mcpx_engine_compiles_total{executable} and log the signature delta,
+    # cost_analysis() is harvested lazily at read time (GET /costs, traced
+    # spans, warmup tail), engine spans carry achieved-FLOP/s rooflines.
+    # Off = the jitted callables are served unwrapped (byte-identical
+    # pass-through; no sentinel, no /costs executable data).
+    cost_accounting: bool = True
     # Replan when a node's observed error-rate breaches this threshold.
     replan_error_rate: float = 0.5
     # or when latency exceeds this multiple of the registry's cost profile.

@@ -81,6 +81,7 @@ from mcpx.planner.grammar import (
 )
 from mcpx.scheduler.admission import ewma_update
 from mcpx.telemetry import tracing
+from mcpx.telemetry.costs import CostRegistry, device_peaks, rounded_roofline
 from mcpx.telemetry.metrics import Metrics
 
 log = logging.getLogger("mcpx.engine")
@@ -214,6 +215,12 @@ class _Slab:
         self.temp = np.zeros((B,), np.float32)
         self.cons = np.zeros((B,), bool)
         self.dfa = np.zeros((B,), np.int32)
+        # Per-row snapshot of the engine's decode cost totals (flops,
+        # bytes, wall seconds) taken at admission for TRACED rows only:
+        # the retirement-time delta is the row's residency roofline
+        # (engine.decode span attrs). Written only when a span rides the
+        # request, so the untraced hot path never touches it.
+        self.cost0 = np.zeros((B, 3), np.float64)
         # Recurrent drafter hidden state (grammar-aware speculative
         # decoding, engine/speculative.py): an embedding-EWMA over the
         # row's emitted tokens, [B, d_model]. Host mirror holds clear
@@ -457,6 +464,23 @@ class InferenceEngine:
             "accepted_free": 0,
         }
         self._spec_window_degraded_logged = False
+        # Roofline cost observatory (telemetry/costs.py): per-executable
+        # XLA cost accounting + the mcpx_engine_compiles_total retrace
+        # sentinel. Created here (not _setup) so GET /costs can read an
+        # empty snapshot from a cold/warming engine.
+        self.costs = CostRegistry(
+            metrics=self.metrics,
+            enabled=self.config.telemetry.cost_accounting,
+        )
+        # Device peaks for span rooflines (None off-TPU: spans then carry
+        # achieved rates + arithmetic intensity without an mfu/bound claim).
+        self._peak_flops_total: Optional[float] = None
+        self._peak_bytes_total: Optional[float] = None
+        # Cumulative decode-segment cost totals {flops, bytes, wall_s},
+        # advanced at harvest while any resident row is traced — the
+        # residency-delta source for engine.decode span rooflines. Worker
+        # thread only.
+        self._seg_cost_totals = {"flops": 0.0, "bytes": 0.0, "wall_s": 0.0}
 
     # ------------------------------------------------------------- lifecycle
     def _transition(self, to: str) -> bool:
@@ -501,6 +525,10 @@ class InferenceEngine:
             # A concurrent aclose() closed the engine mid-start; the
             # transition above lost, and this caller must not serve.
             raise EngineError(f"engine not startable (state={self.state})")
+        # Arm the retrace sentinel: compiles during startup/warmup were the
+        # expected cold path (logged INFO); from here every new signature
+        # is a compile in the SERVING path and logs the WARNING line.
+        self.costs.arm()
 
     async def aclose(self) -> None:
         with self._state_lock:
@@ -525,6 +553,10 @@ class InferenceEngine:
             self._jit_hetero_admit = None
             self._jit_hetero_segment = None
             self._jit_hetero_segment_spec = None
+            # Cost registry keeps its compile/cost history readable but
+            # drops the cached AOT executables (device programs) so a
+            # successor engine fits in HBM.
+            self.costs.release()
             self._stack_cache = None
             self._inflight.clear()
             self._pending_admissions.clear()
@@ -738,31 +770,53 @@ class InferenceEngine:
                     model=self._mesh.shape.get("model", 1),
                     devices=list(self._mesh.devices.flatten()),
                 )
-        self._jit_prefill = jax.jit(
-            self._prefill_impl,
+        # Every jitted executable goes through the cost registry
+        # (telemetry/costs.py): one AOT compile per signature harvests
+        # XLA's cost_analysis() and increments the
+        # mcpx_engine_compiles_total{executable} retrace sentinel; the
+        # compiled executable then serves directly. cost_accounting=false
+        # returns the jitted callables unwrapped (pass-through).
+        wrap = self.costs.wrap
+        self._jit_prefill = wrap(
+            "prefill",
+            jax.jit(
+                self._prefill_impl,
+                static_argnames=("T", "ring"),
+                donate_argnames=("paged_k", "paged_v"),
+            ),
             static_argnames=("T", "ring"),
-            donate_argnames=("paged_k", "paged_v"),
         )
-        self._jit_admit = jax.jit(
-            self._admit_impl, static_argnames=("temperature", "constrained")
+        self._jit_admit = wrap(
+            "admit",
+            jax.jit(self._admit_impl, static_argnames=("temperature", "constrained")),
+            static_argnames=("temperature", "constrained"),
         )
-        self._jit_suffix_prefill = jax.jit(
-            self._suffix_prefill_impl, donate_argnames=("paged_k", "paged_v")
+        self._jit_suffix_prefill = wrap(
+            "suffix_prefill",
+            jax.jit(
+                self._suffix_prefill_impl, donate_argnames=("paged_k", "paged_v")
+            ),
         )
         # out_buf is NOT donated: the pipelined worker reads a LAGGED
         # segment's out_buf after newer segments were already dispatched —
         # donation would invalidate the handle it still has to fetch. The
         # copy is [B, steps] int32, noise next to the KV pools.
-        self._jit_segment = jax.jit(
-            self._segment_impl,
+        self._jit_segment = wrap(
+            "segment",
+            jax.jit(
+                self._segment_impl,
+                static_argnames=(
+                    "iters", "chunk", "temperature", "constrained", "draft",
+                ),
+                donate_argnames=("paged_k", "paged_v"),
+            ),
             static_argnames=("iters", "chunk", "temperature", "constrained", "draft"),
-            donate_argnames=("paged_k", "paged_v"),
         )
         # Merges donate NOTHING: their inputs are the newest segment's
         # output handles, which the newest in-flight entry still needs
         # readable.
-        self._jit_merge = jax.jit(self._merge_impl)
-        self._jit_admit_merge = jax.jit(self._admit_merge_impl)
+        self._jit_merge = wrap("merge", jax.jit(self._merge_impl))
+        self._jit_admit_merge = wrap("admit_merge", jax.jit(self._admit_merge_impl))
         # Heterogeneous batching executables: temperature/constrained are
         # DEVICE VECTORS here, not static args, and the grammar arrives as a
         # stacked [G, S, C] table set indexed by a per-row dfa_id — so ONE
@@ -770,21 +824,43 @@ class InferenceEngine:
         # every resident-grammar combination (the executable count is
         # independent of how many grammars are resident; acceptance
         # criterion of the hetero refactor).
-        self._jit_hetero_admit = jax.jit(self._hetero_admit_impl)
-        self._jit_hetero_segment = jax.jit(
-            self._hetero_segment_impl,
+        self._jit_hetero_admit = wrap(
+            "hetero_admit", jax.jit(self._hetero_admit_impl)
+        )
+        self._jit_hetero_segment = wrap(
+            "hetero_segment",
+            jax.jit(
+                self._hetero_segment_impl,
+                static_argnames=("iters", "chunk"),
+                donate_argnames=("paged_k", "paged_v"),
+            ),
             static_argnames=("iters", "chunk"),
-            donate_argnames=("paged_k", "paged_v"),
         )
         # Grammar-aware speculative decoding (engine/speculative.py): the
         # drafter-propose + one-forward-verify segment. K and the draft
         # mode are config statics (ONE executable per config), never
         # per-acceptance — variable accepted lengths are data.
-        self._jit_hetero_segment_spec = jax.jit(
-            self._hetero_segment_spec_impl,
+        self._jit_hetero_segment_spec = wrap(
+            "hetero_segment_spec",
+            jax.jit(
+                self._hetero_segment_spec_impl,
+                static_argnames=("iters", "K", "draft"),
+                donate_argnames=("paged_k", "paged_v"),
+            ),
             static_argnames=("iters", "K", "draft"),
-            donate_argnames=("paged_k", "paged_v"),
         )
+        try:
+            # Datasheet peaks over the chips this engine actually meshes:
+            # the denominator for span roofline attrs. None off-TPU (spans
+            # then report achieved rates without an mfu/bound claim).
+            pk = device_peaks()
+            n_chips = int(self._mesh.devices.size)
+            if pk.get("flops_per_chip"):
+                self._peak_flops_total = pk["flops_per_chip"] * n_chips
+            if pk.get("hbm_bytes_s_per_chip"):
+                self._peak_bytes_total = pk["hbm_bytes_s_per_chip"] * n_chips
+        except Exception:  # noqa: BLE001 - peaks are telemetry, never fatal
+            log.debug("device peak lookup failed", exc_info=True)
         if ecfg.speculative.enabled and ecfg.hetero_batch:
             # The verify window samples [B, K+1]-shaped draws each forward;
             # with the default non-partitionable threefry every mesh device
@@ -1116,6 +1192,12 @@ class InferenceEngine:
         self._dirty_rows.add(0)
         self._dispatch_merge(slab, [])
         jax.block_until_ready(self._paged_kv["k"])
+        # Materialise the cost table for every warmed signature NOW (one
+        # lazy AOT compile each — on TPU these hit the persistent XLA
+        # cache): a warmed engine then never compiles for accounting in
+        # the serving path, extending warmup's no-compiles-while-serving
+        # contract to the observatory.
+        self.costs.snapshot(materialize=True)
 
     def _put(self, x, spec: P):
         return jax.device_put(x, self._named(spec))
@@ -1296,6 +1378,39 @@ class InferenceEngine:
             hst.at[rows].set(hst_v, mode="drop"),
         )
 
+    def _span_roofline(
+        self,
+        flops: Optional[float],
+        bytes_accessed: Optional[float],
+        wall_s: float,
+    ) -> dict:
+        """Rounded roofline attrs for engine spans: achieved FLOP/s and
+        bytes/s, arithmetic intensity, and — when datasheet peaks are known
+        for this hardware — mfu / HBM-bandwidth utilisation / which roof
+        binds. Empty when XLA published no costs (labeled absence beats a
+        guessed number). With pipeline_depth > 1 consecutive segment spans
+        overlap, so per-span achieved rates are upper-bounded approximations
+        of the interval — the bench's phase rooflines (cumulative totals /
+        phase wall) are the exact ones."""
+        rl = rounded_roofline(
+            flops,
+            bytes_accessed,
+            wall_s,
+            peak_flops=self._peak_flops_total,
+            peak_bytes_s=self._peak_bytes_total,
+        )
+        out: dict[str, Any] = {
+            k: rl[k]
+            for k in (
+                "achieved_flops_s", "achieved_bytes_s",
+                "arithmetic_intensity", "mfu", "hbm_bw_util",
+            )
+            if k in rl
+        }
+        if "bound" in rl:
+            out["roofline_bound"] = rl["bound"]
+        return out
+
     def _poll_admissions(self, slab: "_Slab") -> None:
         """Resolve pending admission chains whose device work has finished
         (non-blocking ``is_ready`` checks, FIFO — device order means a
@@ -1305,7 +1420,7 @@ class InferenceEngine:
         this replaces."""
         now = time.monotonic()
         while self._pending_admissions:
-            t0, marker, rows, gens, t_admit0 = self._pending_admissions[0]
+            t0, marker, rows, gens, t_admit0, pf_entry = self._pending_admissions[0]
             if not marker.is_ready():
                 # Purge entries whose rows were ALL cancelled/reaped before
                 # the marker resolved — otherwise they hold device handles
@@ -1327,6 +1442,11 @@ class InferenceEngine:
                 slab.t_decode0[i] = now
                 r = slab.req[i]
                 if r.span is not None:
+                    if pf_entry is not None:
+                        # Lazy cost materialisation (one AOT compile per
+                        # signature, idempotent): paid only when a traced
+                        # request actually reads the numbers.
+                        pf_entry.ensure()
                     # Admission-start to chain-completion: host prep, the
                     # cohort prefill this row rode in, commit-to-pages and
                     # first sample (observed <=1 tick late, same as the
@@ -1336,6 +1456,14 @@ class InferenceEngine:
                         t0=t_admit0,
                         t1=now,
                         dfa_id=int(slab.dfa[i]),
+                        # XLA-derived roofline of the cohort prefill this
+                        # row rode in (whole-cohort cost over the chain's
+                        # wall window — per-row attribution would be a lie).
+                        **self._span_roofline(
+                            pf_entry.flops if pf_entry is not None else None,
+                            pf_entry.bytes_accessed if pf_entry is not None else None,
+                            now - t_admit0,
+                        ),
                     )
 
     def _dispatch_merge(self, slab: "_Slab", rows: list[int]) -> None:
@@ -2916,6 +3044,7 @@ class InferenceEngine:
                     self._paged_kv["k"],
                     self._paged_kv["v"],
                 )
+                pf_entry = getattr(self._jit_suffix_prefill, "last_entry", None)
             else:
                 (
                     tokens_d, lens_d, table_d, budgets_d, active_d,
@@ -2943,6 +3072,7 @@ class InferenceEngine:
                     T=T,
                     ring=use_ring,
                 )
+                pf_entry = getattr(self._jit_prefill, "last_entry", None)
             # Pools were donated to prefill: point at the live buffers
             # immediately so an exception below can't leave stale handles.
             self._paged_kv = {"k": k_p, "v": v_p}
@@ -3029,6 +3159,8 @@ class InferenceEngine:
                 # phases care about, now per request instead of only as a
                 # histogram.
                 slab.n_traced += 1
+                tot = self._seg_cost_totals
+                slab.cost0[i] = (tot["flops"], tot["bytes"], tot["wall_s"])
                 r.span.child(
                     "engine.queue_wait",
                     t0=r.enqueued_at,
@@ -3095,7 +3227,10 @@ class InferenceEngine:
             self._reset_pools()
             return
         self._pending_admissions.append(
-            (t1, slab.dev[4], rows_idx, [int(slab.gen[i]) for i in rows_idx], t0)
+            (
+                t1, slab.dev[4], rows_idx,
+                [int(slab.gen[i]) for i in rows_idx], t0, pf_entry,
+            )
         )
         self.metrics.kv_page_utilization.set(self._allocator.stats().utilization)
         self.metrics.batch_occupancy.set(slab.n_active)
@@ -3236,6 +3371,11 @@ class InferenceEngine:
         # Dispatch timestamp only when some resident request is traced: the
         # disabled/unsampled hot path must not even pay the clock read.
         t_disp = time.monotonic() if slab.n_traced else 0.0
+        seg_exec = (
+            self._jit_hetero_segment_spec
+            if hetero and slab.spec
+            else self._jit_hetero_segment if hetero else self._jit_segment
+        )
         self._inflight.append(
             (
                 done_d, e_d, buf_d, n_fwd, slab.gen.copy(), t_disp,
@@ -3244,6 +3384,10 @@ class InferenceEngine:
                 # plus the dispatch-time class snapshot they attribute by.
                 (dr_d, ac_d) if dr_d is not None else None,
                 cons_snap,
+                # The cost-registry entry of the executable just dispatched
+                # (None when cost accounting is off): harvest attributes
+                # the segment's XLA flops/bytes to traced spans with it.
+                getattr(seg_exec, "last_entry", None),
             )
         )
 
@@ -3281,6 +3425,14 @@ class InferenceEngine:
             self.metrics.spec_accept_rate.labels(cls="free").set(
                 t["accepted_free"] / t["drafted_free"]
             )
+        # Overall accept rate as its own gauge series: queue_stats()'s
+        # spec_accept_rate field on /metrics, so the headline rate is
+        # scrapeable without reconstructing it from per-class counters.
+        tot_drafted = t["drafted_constrained"] + t["drafted_free"]
+        if tot_drafted:
+            self.metrics.spec_accept_rate.labels(cls="overall").set(
+                (t["accepted_constrained"] + t["accepted_free"]) / tot_drafted
+            )
 
     def _harvest(self, slab: "_Slab", keep_inflight: int) -> None:
         """Fetch flags + out_buf of in-flight segments (oldest first) until
@@ -3294,6 +3446,7 @@ class InferenceEngine:
         while len(self._inflight) > keep_inflight:
             (
                 done_d, e_d, buf_d, nfwd_d, gen_snap, t_disp, spec_h, cons_snap,
+                seg_cost,
             ) = self._inflight.popleft()
             # ONE combined fetch (flags + out_buf): the tunnel's cost is the
             # round trip (~72ms), not the ~24KB of buffer — splitting into
@@ -3318,6 +3471,27 @@ class InferenceEngine:
             t1 = time.monotonic()
             self.metrics.decode_forwards.inc(int(n_fwd))
             if t_disp:
+                # Segment cost accumulation (traced windows only — t_disp
+                # is set iff some resident row is traced, which holds for
+                # every segment of a traced row's residency): the
+                # engine.decode span's residency roofline is the delta of
+                # these totals between admission and retirement.
+                seg_wall = t1 - t_disp
+                if seg_cost is not None:
+                    # Lazy cost materialisation: only traced windows read
+                    # the XLA numbers, and only the first read per
+                    # signature compiles (idempotent).
+                    seg_cost.ensure()
+                if seg_cost is not None and seg_cost.flops is not None:
+                    tot = self._seg_cost_totals
+                    tot["flops"] += seg_cost.flops
+                    tot["bytes"] += seg_cost.bytes_accessed or 0.0
+                    tot["wall_s"] += seg_wall
+                seg_attrs = self._span_roofline(
+                    seg_cost.flops if seg_cost is not None else None,
+                    seg_cost.bytes_accessed if seg_cost is not None else None,
+                    seg_wall,
+                )
                 # Per-segment decode attribution for traced rows: dispatch
                 # to (lagged) harvest, per-row token delta against the host
                 # emitted mirror (valid per row lifetime: cleared to 0 at
@@ -3336,6 +3510,10 @@ class InferenceEngine:
                         dfa_id=int(slab.dfa[i]),
                         cls="constrained" if slab.cons[i] else "free",
                         forwards=int(n_fwd),
+                        # Whole-slab segment roofline (XLA cost over the
+                        # dispatch->harvest window) — identical across the
+                        # segment's rows by construction.
+                        **seg_attrs,
                     )
                     if dr is not None:
                         # Speculation attribution per traced row: how many
@@ -3377,12 +3555,23 @@ class InferenceEngine:
                     # Slab residency (admission to delivery, the pipeline's
                     # depth-1 lag included): the summary span whose window
                     # the engine.segment spans subdivide.
+                    # Residency roofline: decode-segment cost totals
+                    # accumulated since this row's admission snapshot, over
+                    # its decode wall — the whole-slab achieved rate during
+                    # the row's residency (cost0 is per-row, the work is
+                    # the slab's).
+                    tot = self._seg_cost_totals
                     r.span.child(
                         "engine.decode",
                         t0=slab.t_decode0[i],
                         t1=t1,
                         tokens=len(ids),
                         row=i,
+                        **self._span_roofline(
+                            tot["flops"] - slab.cost0[i, 0] or None,
+                            tot["bytes"] - slab.cost0[i, 1] or None,
+                            t1 - slab.t_decode0[i],
+                        ),
                     )
                     if self.config.tracing.exemplars and r.span.record.sampled:
                         # Head-unsampled traces are (usually) never

@@ -7,6 +7,8 @@ Endpoints (reference ``control_plane.py:133-151``):
 
 plus the subsystems the reference only advertises:
   GET  /metrics    Prometheus text exposition (README.md:43-44, made real)
+  GET  /costs      per-executable XLA cost accounting + compile sentinel +
+                   device peaks/HBM stats (mcpx/telemetry/costs.py)
   GET  /healthz    liveness + engine readiness
   GET  /telemetry  per-service rolling stats snapshot
   GET/POST /services, GET/DELETE /services/{name}   registry CRUD
@@ -77,7 +79,10 @@ _LIMITED = {"/plan", "/execute", "/plan_and_execute"}
 # polling /metrics or an operator paging through /traces would otherwise
 # flush the ring with traces OF the observability itself — and `mcpx trace
 # dump`'s "newest trace" would be its own /traces listing.
-_UNTRACED = {"/metrics", "/traces", "/traces/{trace_id}", "/healthz", "/telemetry"}
+_UNTRACED = {
+    "/metrics", "/costs", "/traces", "/traces/{trace_id}", "/healthz",
+    "/telemetry",
+}
 
 
 def build_app(cp: ControlPlane) -> web.Application:
@@ -316,6 +321,17 @@ def build_app(cp: ControlPlane) -> web.Application:
 
     # --------------------------------------------------------- observability
     async def metrics_handler(request: web.Request) -> web.Response:
+        # HBM pressure gauges refresh at scrape time. Gated on engine
+        # READINESS, not presence: a heuristic-only server must not
+        # initialise jax to serve its own metrics, and a cold/warming
+        # engine's first scrape must not dial a TPU tunnel on the event
+        # loop either — once ready, the worker already initialised the
+        # backend and memory_stats() is a cheap C call.
+        engine = getattr(cp.planner, "engine", None)
+        if engine is not None and getattr(engine, "state", None) == "ready":
+            from mcpx.telemetry.costs import update_hbm_gauges
+
+            update_hbm_gauges(cp.metrics)
         # OpenMetrics on request (Accept negotiation): the exposition that
         # renders the exemplar trace ids the latency histograms carry —
         # a latency spike links to a concrete GET /traces/{id} trace.
@@ -349,6 +365,52 @@ def build_app(cp: ControlPlane) -> web.Application:
             # chrome://tracing (docs/observability.md; `mcpx trace dump`).
             return web.json_response(rec.to_chrome())
         return web.json_response(rec.to_dict())
+
+    async def costs_handler(request: web.Request) -> web.Response:
+        """Roofline cost observatory (mcpx/telemetry/costs.py,
+        docs/observability.md): per-executable XLA cost_analysis table +
+        compile counts (the retrace sentinel's raw data), device peaks and
+        per-device HBM stats. Engine-gated like the HBM gauges above."""
+        engine = getattr(cp.planner, "engine", None)
+        if engine is None or getattr(engine, "costs", None) is None:
+            return web.json_response(
+                {
+                    "engine": None,
+                    "device": None,
+                    "reason": "no inference engine attached "
+                    "(heuristic/mock planner serves this control plane)",
+                }
+            )
+        if engine.state != "ready":
+            # Cold/warming engine: the compile history so far is readable
+            # (materialize=False — no lazy AOT compiles), but device
+            # queries are deferred — they would initialise the jax backend
+            # (dial a TPU tunnel) from the scrape path.
+            return web.json_response(
+                {
+                    "engine": engine.costs.snapshot(materialize=False),
+                    "engine_state": engine.state,
+                    "device": None,
+                    "reason": "engine not ready; device stats deferred",
+                }
+            )
+        from mcpx.telemetry.costs import device_peaks, hbm_stats, update_hbm_gauges
+
+        # Off the event loop: materialising pending cost entries lazily
+        # AOT-compiles (seconds per signature, first scrape only), and the
+        # device queries belong with it.
+        def _read():
+            update_hbm_gauges(cp.metrics)
+            return (engine.costs.snapshot(), device_peaks(), hbm_stats())
+
+        snap, peaks, hbm = await asyncio.to_thread(_read)
+        return web.json_response(
+            {
+                "engine": snap,
+                "engine_state": engine.state,
+                "device": {"peaks": peaks, "hbm": hbm},
+            }
+        )
 
     async def telemetry_handler(request: web.Request) -> web.Response:
         return web.json_response(
@@ -453,6 +515,7 @@ def build_app(cp: ControlPlane) -> web.Application:
     app.router.add_get("/services/{name}", get_service)
     app.router.add_delete("/services/{name}", delete_service)
     app.router.add_get("/metrics", metrics_handler)
+    app.router.add_get("/costs", costs_handler)
     app.router.add_get("/traces", traces_handler)
     app.router.add_get("/traces/{trace_id}", trace_get)
     app.router.add_get("/telemetry", telemetry_handler)

@@ -212,6 +212,35 @@ class Metrics:
             ["cls"],
             registry=self.registry,
         )
+        # Roofline cost observatory (mcpx/telemetry/costs.py,
+        # docs/observability.md): the retrace sentinel + HBM pressure.
+        self.engine_compiles = Counter(
+            "mcpx_engine_compiles_total",
+            "XLA compiles per engine executable (cost registry signature "
+            "misses). After warmup this series should be FLAT: a growing "
+            "rate for one executable is a recompile storm — a shape/dtype "
+            "leaking into a jitted call per request — previously only "
+            "catchable by compile-count tests; the paired log line names "
+            "the exact argument leaf that changed",
+            ["executable"],
+            registry=self.registry,
+        )
+        self.hbm_bytes_in_use = Gauge(
+            "mcpx_hbm_bytes_in_use",
+            "Device memory in use (memory_stats), per local device — with "
+            "mcpx_engine_kv_page_utilization this splits HBM pressure into "
+            "weights+workspace vs KV pages. Absent on backends without "
+            "allocator stats (the CPU proxy); refreshed at /metrics and "
+            "/costs scrape time",
+            ["device"],
+            registry=self.registry,
+        )
+        self.hbm_bytes_limit = Gauge(
+            "mcpx_hbm_bytes_limit",
+            "Device memory capacity (memory_stats), per local device",
+            ["device"],
+            registry=self.registry,
+        )
         self.resident_grammars = Gauge(
             "mcpx_engine_resident_grammars",
             "Distinct constrained grammars resident in the decode slab "

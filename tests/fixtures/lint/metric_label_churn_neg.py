@@ -1,0 +1,29 @@
+"""Negative fixture: init-time construction and bounded label sources."""
+import collections
+
+from prometheus_client import Counter
+
+
+class Metrics:
+    def __init__(self, registry):
+        # Construction at init time, once per registry: the sanctioned home.
+        self.requests = Counter(
+            "reqs_total", "requests", ["endpoint", "status"], registry=registry
+        )
+
+
+async def bounded_labels(metrics, endpoint, status, degraded):
+    # Plain names bound upstream (route template, outcome enum) and
+    # literals: bounded by construction.
+    metrics.requests.labels(endpoint=endpoint, status=status).inc()
+    metrics.requests.labels(endpoint="/plan", status="ok").inc()
+    metrics.requests.labels(status="degraded" if degraded else "admitted").inc()
+
+
+async def not_prometheus(items):
+    # collections.Counter is not a metric; a two-string call shape is what
+    # distinguishes the prometheus constructors.
+    c = collections.Counter()
+    c["x"] += 1
+    tally = collections.Counter(items)
+    return tally

@@ -1,0 +1,17 @@
+"""Positive fixture: metrics minted / label values synthesised per request."""
+from prometheus_client import Counter, Gauge
+
+
+async def mint_per_request(registry):
+    c = Counter("reqs_total", "requests served", registry=registry)
+    c.inc()
+    g = Gauge("inflight", "in-flight requests", registry=registry)
+    g.set(1)
+
+
+async def label_churn(metrics, request, intent, url):
+    metrics.requests.labels(endpoint=f"/plan/{intent}").inc()
+    metrics.requests.labels("intent: " + intent).inc()
+    metrics.requests.labels(path=request.path).inc()
+    metrics.requests.labels(tenant="tenant-%s" % intent).inc()
+    metrics.requests.labels(ep="{}".format(url)).inc()

@@ -1,0 +1,295 @@
+"""Tier-1 schema gate for the bench output JSON (ISSUE 7 satellite) and
+the `mcpx bench report` regression tracker.
+
+The gate pins the NEW observability fields — the roofline block,
+``pallas_reason``, and the embedded regression verdict — against
+``bench._output_json`` so a later PR cannot silently drop them from the
+one JSON line the driver persists. Host-side pure functions only: no
+engine, no device, no timed phases."""
+
+import io
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402  (stdlib-only module level; jax untouched)
+from mcpx.cli.bench_report import (  # noqa: E402
+    build_report,
+    default_series,
+    load_runs,
+    run_report,
+)
+
+
+def _stats(**overrides):
+    """A representative ``_run`` stats dict (the fields _output_json reads)."""
+    base = {
+        "plans_per_sec": 5.0,
+        "p50_ms": 100.0,
+        "p99_ms": 200.0,
+        "open_loop_rate": 3.5,
+        "sat_p50_ms": 150.0,
+        "sat_p99_ms": 300.0,
+        "llm_share": 1.0,
+        "decode_tok_s": 80.0,
+        "decode_forwards": 100,
+        "tok_per_forward": 2.0,
+        "prefill_tokens": 1000,
+        "mfu": 0.001,
+        "mfu_basis": "xla_cost_analysis",
+        "roofline": {
+            "basis": "xla_cost_analysis",
+            "mfu_basis": "xla_cost_analysis",
+            "peak_flops": 1e12,
+            "peak_flops_basis": "measured_matmul",
+            "peak_bytes_s": None,
+            "phases": {
+                "sat": {
+                    "flops": 1e9,
+                    "bytes_accessed": 1e8,
+                    "wall_s": 1.0,
+                    "achieved_flops_s": 1e9,
+                    "achieved_bytes_s": 1e8,
+                    "arithmetic_intensity": 10.0,
+                    "mfu": 0.001,
+                    "hbm_bw_util": None,
+                    "bound": None,
+                },
+                "open": None,
+            },
+            "mfu_analytic": 0.0008,
+            "xla_vs_analytic": 1.2,
+        },
+        "pallas_reason": "cpu backend: Mosaic TPU kernels cannot run — "
+        "the fused-jnp reference attention serves",
+        "phase_tok_per_forward": {"sat": 2.0, "open": 2.0},
+        "phase_p50_ms": {"queue": 1.0, "prefill": 2.0, "decode": 3.0},
+        "phase_p50_open_ms": {"queue": 1.0, "prefill": 2.0, "decode": 3.0},
+        "plan_quality": {"score": 0.2},
+        "backend": "cpu",
+        "n_services": 1000,
+        "n_requests": 16,
+        "errors": 0,
+        "overload": None,
+        "mixed": None,
+        "spec": None,
+        "latency_attribution": None,
+        "chaos": None,
+        "grammar_fallback": {"shape_only": 0, "keys_free": 0, "typed_off": 0},
+        "cache_hit_share": 0.0,
+        "unique_intents": 0,
+    }
+    base.update(overrides)
+    return base
+
+
+# ------------------------------------------------------------- schema gate
+def test_output_schema_carries_roofline_pallas_reason_and_verdict():
+    out = bench._output_json(_stats(), {"score": 0.86}, "test")
+    # The pre-existing contract fields stay.
+    for key in (
+        "metric", "value", "p50_ms", "llm_share", "mfu", "mfu_basis",
+        "pallas", "spec_speedup", "chaos_success_rate", "grammar_fallback",
+    ):
+        assert key in out, key
+    # ISSUE 7 fields: the roofline block…
+    rf = out["roofline"]
+    assert rf is not None
+    assert rf["basis"] == "xla_cost_analysis"
+    assert rf["mfu_basis"] == "xla_cost_analysis"
+    sat = rf["phases"]["sat"]
+    for key in (
+        "achieved_flops_s", "achieved_bytes_s", "arithmetic_intensity",
+        "mfu", "flops", "bytes_accessed",
+    ):
+        assert key in sat, key
+    assert rf["mfu_analytic"] is not None
+    # …pallas_reason…
+    assert isinstance(out["pallas_reason"], str) and out["pallas_reason"]
+    # …and the embedded regression verdict.
+    assert isinstance(out["regression"], dict)
+    assert "verdict" in out["regression"]
+    json.dumps(out)  # the one-line artifact must stay JSON-serializable
+
+
+def test_output_roofline_never_null_even_without_accounting():
+    """Acceptance: the roofline block is non-null with a LABELED fallback
+    when cost accounting was unavailable — never silently absent."""
+    out = bench._output_json(
+        _stats(roofline=None, mfu_basis="measured_matmul"), None, "test"
+    )
+    assert out["roofline"] is not None
+    assert out["roofline"]["basis"] == "unavailable"
+    assert out["roofline"]["mfu_basis"] == "unavailable"
+    assert "phases" in out["roofline"]
+
+
+def test_roofline_block_from_cost_snapshots():
+    """_roofline_block turns /costs snapshot deltas into per-phase achieved
+    rates; a missing scrape degrades to basis='unavailable'."""
+
+    def snap(flops, byt):
+        return {"engine": {"totals": {"flops_executed": flops, "bytes_executed": byt}}}
+
+    block = bench._roofline_block(
+        snap(0.0, 0.0), snap(2e9, 4e8), snap(3e9, 6e8),
+        sat_wall=2.0, open_wall=1.0,
+        peak_flops=1e12, peak_flops_basis="measured_matmul", peak_bytes=None,
+        mfu_analytic=0.001, analytic_flops=1e9,
+    )
+    assert block["basis"] == "xla_cost_analysis"
+    sat, opn = block["phases"]["sat"], block["phases"]["open"]
+    assert sat["achieved_flops_s"] == 1e9
+    assert sat["mfu"] == 0.001
+    assert sat["arithmetic_intensity"] == 5.0
+    assert opn["achieved_flops_s"] == 1e9
+    assert block["xla_vs_analytic"] == 2.0
+    degraded = bench._roofline_block(
+        None, None, None, 2.0, 1.0, 1e12, "measured_matmul", None, 0.001, 1e9
+    )
+    assert degraded["basis"] == "unavailable"
+    assert degraded["phases"]["sat"] is None
+
+
+def test_pallas_reason_covers_the_off_paths(monkeypatch):
+    # CPU backend (the tier-1 platform).
+    monkeypatch.setattr(bench, "_on_tpu", lambda: False)
+    assert "cpu backend" in bench._pallas_reason()
+    # Operator override on TPU.
+    monkeypatch.setattr(bench, "_on_tpu", lambda: True)
+    monkeypatch.setenv("MCPX_BENCH_PALLAS", "0")
+    assert "MCPX_BENCH_PALLAS=0" in bench._pallas_reason()
+    # Engine hardware probe rejected the kernel.
+    monkeypatch.setenv("MCPX_BENCH_PALLAS", "1")
+    assert "head_dim" in bench._pallas_reason(engine_use_pallas=False)
+    # Smoke artifact proved fused-jnp only.
+    monkeypatch.delenv("MCPX_BENCH_PALLAS")
+    monkeypatch.setattr(bench, "_smoke_artifact", lambda: {"ok": True, "pallas": False})
+    assert "smoke" in bench._pallas_reason()
+    # Nothing says off.
+    monkeypatch.setattr(bench, "_smoke_artifact", lambda: {"ok": True, "pallas": True})
+    assert bench._pallas_reason(engine_use_pallas=True) == "enabled"
+
+
+# --------------------------------------------------------- regression report
+def test_bench_report_over_committed_series():
+    """ISSUE 7 acceptance: `mcpx bench report` over >= 2 committed
+    BENCH_r*.json files produces a regression verdict."""
+    runs = load_runs(default_series(REPO))
+    assert len(runs) >= 2, "committed BENCH series shrank below 2 readable runs?"
+    report = build_report(runs)
+    assert report["verdict"] in ("ok", "regressed", "no_comparable_series")
+    assert report["metrics"], "no tracked metrics evaluated"
+    # The headline metric must have been comparable across the series.
+    assert report["metrics"]["value"]["verdict"] in ("ok", "improved", "regressed")
+    json.dumps(report)
+
+
+def _mk_run(value, p50, **extra):
+    return {
+        "metric": "plans_per_sec", "value": value, "p50_ms": p50,
+        "model": "test", "backend": "cpu", "vocab": "bpe",
+        "quantize": "none", "registry": "synthetic", "n_services": 1000,
+        **extra,
+    }
+
+
+def test_report_verdicts_bands_and_scenario_exclusion():
+    runs = [
+        ("r1", _mk_run(10.0, 100.0)),
+        ("r2", _mk_run(10.5, 102.0)),
+        ("r3", _mk_run(9.8, 98.0)),
+        # A different scenario must be excluded, not averaged in.
+        ("tpu", dict(_mk_run(500.0, 5.0), backend="tpu", model="2b")),
+        # Latest: throughput fine (inside band), p50 3x worse (outside).
+        ("r4", _mk_run(10.1, 300.0)),
+    ]
+    report = build_report(runs)
+    assert report["verdict"] == "regressed"
+    assert report["excluded_scenario_mismatch"] == ["tpu"]
+    assert set(report["compared_against"]) == {"r1", "r2", "r3"}
+    assert report["metrics"]["value"]["verdict"] == "ok"
+    m = report["metrics"]["p50_ms"]
+    assert m["verdict"] == "regressed"
+    assert m["delta_frac"] > m["band_frac"]
+    assert "p50_ms" in report["regressions"]
+    # Improvement in the good direction reads as improved, not regressed.
+    runs[-1] = ("r4", _mk_run(20.0, 99.0))
+    report = build_report(runs)
+    assert report["verdict"] == "ok"
+    assert report["metrics"]["value"]["verdict"] == "improved"
+
+
+def test_report_missing_metric_is_flagged_when_it_vanishes():
+    prior = [("a", _mk_run(10.0, 100.0, mfu=0.01)) for _ in range(3)]
+    latest = ("z", _mk_run(10.0, 100.0))  # mfu dropped
+    report = build_report([*prior, latest])
+    assert report["metrics"]["mfu"]["verdict"] == "missing"
+    assert report["metrics"]["mfu"]["previous_median"] == 0.01
+    # Surfaced in the top-level missing list, but NOT a regression verdict:
+    # optional phases null their metrics legitimately; dropped FIELDS are
+    # the schema gate's business.
+    assert "mfu" in report["missing"]
+    assert report["verdict"] == "ok"
+
+
+def test_mfu_compared_only_within_matching_basis():
+    """A measurement-basis change (analytic -> xla_cost_analysis) must not
+    read as a performance regression/improvement: mfu only compares
+    against prior runs with the SAME mfu_basis."""
+    prior = [
+        (f"a{i}", _mk_run(10.0, 100.0, mfu=0.005, mfu_basis="measured_matmul"))
+        for i in range(3)
+    ]
+    shifted = ("z", _mk_run(10.0, 100.0, mfu=0.02, mfu_basis="xla_cost_analysis"))
+    rep = build_report([*prior, shifted])
+    assert rep["metrics"]["mfu"]["verdict"] == "new"  # no cross-basis priors
+    assert rep["metrics"]["mfu"]["basis"] == "xla_cost_analysis"
+    same_basis = ("z2", _mk_run(10.0, 100.0, mfu=0.002, mfu_basis="measured_matmul"))
+    rep = build_report([*prior, same_basis])
+    assert rep["metrics"]["mfu"]["verdict"] == "regressed"
+
+
+def test_run_report_cli_exit_codes(tmp_path):
+    p1 = tmp_path / "BENCH_r01.json"
+    p2 = tmp_path / "BENCH_r02.json"
+    p1.write_text(json.dumps(_mk_run(10.0, 100.0)))
+    p2.write_text(json.dumps(_mk_run(10.0, 500.0)))  # p50 regressed 5x
+    out = io.StringIO()
+    assert run_report([str(p1), str(p2)], fmt="json", out=out) == 0
+    payload = json.loads(out.getvalue())
+    assert payload["verdict"] == "regressed"
+    assert run_report(
+        [str(p1), str(p2)], fail_on_regression=True, out=io.StringIO()
+    ) == 1
+    # Fewer than two readable artifacts is a usage error, not a crash.
+    assert run_report([str(p1)], out=io.StringIO()) == 2
+    # Driver-wrapper artifacts ({"parsed": ...}) unwrap transparently.
+    p3 = tmp_path / "BENCH_r03.json"
+    p3.write_text(json.dumps({"rc": 0, "parsed": _mk_run(11.0, 101.0)}))
+    out = io.StringIO()
+    assert run_report([str(p1), str(p3)], fmt="json", out=out) == 0
+    assert json.loads(out.getvalue())["latest"] == "BENCH_r03.json"
+
+
+def test_cli_subcommand_wiring(tmp_path):
+    from mcpx.cli.main import main
+
+    p1 = tmp_path / "a.json"
+    p2 = tmp_path / "b.json"
+    p1.write_text(json.dumps(_mk_run(10.0, 100.0)))
+    p2.write_text(json.dumps(_mk_run(10.2, 101.0)))
+    assert main(["bench", "report", str(p1), str(p2)]) == 0
+    assert main(["bench", "report", "--format", "json", str(p1), str(p2)]) == 0
+
+
+def test_regression_block_embedded_against_repo_series():
+    out = bench._output_json(_stats(), None, "test")
+    reg = out["regression"]
+    # The repo ships >= 2 comparable CPU-proxy rounds, so the embedded
+    # verdict must have actually compared something.
+    assert reg["verdict"] in ("ok", "regressed")
+    assert reg["compared_against"]

@@ -31,6 +31,7 @@ RULE_IDS = {
     "broad-except",
     "blank-lines",
     "unbounded-retry-loop",
+    "metric-label-churn",
 }
 
 
@@ -103,6 +104,21 @@ def test_per_token_host_loop_negative():
     # (jit-host-sync's business) and feedback through plain-Python helpers
     # stay silent.
     assert hits("per_token_host_loop_neg.py", "per-token-host-loop") == []
+
+
+def test_metric_label_churn_positive():
+    # Two per-request metric constructions, then five label values
+    # synthesised in the request path: f-string, concat, request.path,
+    # %-format, .format().
+    assert hits("metric_label_churn_pos.py", "metric-label-churn") == [
+        6, 8, 13, 14, 15, 16, 17,
+    ]
+
+
+def test_metric_label_churn_negative():
+    # Init-time construction, bounded Name/literal/conditional labels, and
+    # collections.Counter stay silent.
+    assert hits("metric_label_churn_neg.py", "metric-label-churn") == []
 
 
 def test_committed_baseline_is_empty():

@@ -165,6 +165,107 @@ def test_missing_registration_returns_400():
     asyncio.run(go())
 
 
+def test_profile_transition_in_progress_409(monkeypatch):
+    """The concurrency contract of /profile/start|stop (ISSUE 7 satellite):
+    while a start's ``start_trace`` is still in flight in a worker thread,
+    a concurrent stop must 409 on the _STARTING sentinel ("transition in
+    progress") and a concurrent start must 409 on the reservation — neither
+    may race jax's single-session profiler state."""
+    import threading
+
+    import jax
+
+    release = threading.Event()
+    entered = threading.Event()
+    calls = {"start": 0, "stop": 0}
+
+    def fake_start(trace_dir):
+        calls["start"] += 1
+        entered.set()
+        release.wait(10)
+
+    def fake_stop():
+        calls["stop"] += 1
+
+    async def go():
+        cp, app = make_app()
+        monkeypatch.setattr(jax.profiler, "start_trace", fake_start)
+        monkeypatch.setattr(jax.profiler, "stop_trace", fake_stop)
+
+        async def drive(client):
+            task = asyncio.create_task(
+                client.post("/profile/start", json={"dir": "/tmp/mcpx-prof-t"})
+            )
+            assert await asyncio.to_thread(entered.wait, 10)
+            # start_trace is blocked in its thread: the reservation is live.
+            r = await client.post("/profile/stop")
+            assert r.status == 409
+            assert "transition in progress" in (await r.json())["error"]
+            r2 = await client.post("/profile/start", json={"dir": "/tmp/other"})
+            assert r2.status == 409  # reservation counts as "already active"
+            release.set()
+            r0 = await task
+            assert r0.status == 200
+            r3 = await client.post("/profile/stop")
+            assert r3.status == 200
+            assert calls == {"start": 1, "stop": 1}
+
+        await with_client(app, drive)
+
+    asyncio.run(go())
+
+
+def test_shutdown_during_profiler_transition_skips_flush(monkeypatch):
+    """Shutdown racing an in-flight profiler transition must SKIP the
+    at-shutdown flush (flushing would race the transition thread inside
+    jax's profiler) and clear the sentinel — previously only a code
+    comment, now pinned."""
+    import threading
+
+    import jax
+
+    release = threading.Event()
+    entered = threading.Event()
+    calls = {"start": 0, "stop": 0}
+
+    def fake_start(trace_dir):
+        calls["start"] += 1
+
+    def fake_stop():
+        calls["stop"] += 1
+        entered.set()
+        release.wait(10)
+
+    async def go():
+        cp, app = make_app()
+        monkeypatch.setattr(jax.profiler, "start_trace", fake_start)
+        monkeypatch.setattr(jax.profiler, "stop_trace", fake_stop)
+
+        async def drive(client):
+            r = await client.post("/profile/start", json={"dir": "/tmp/mcpx-prof-s"})
+            assert r.status == 200
+            task = asyncio.create_task(client.post("/profile/stop"))
+            assert await asyncio.to_thread(entered.wait, 10)
+            # Stop is mid-flight (_STOPPING). Run the app's cleanup NOW —
+            # the shutdown-during-transition path: it must not dispatch a
+            # second stop_trace (the flush) and must clear the sentinel.
+            before = calls["stop"]
+            for cb in app.on_cleanup:
+                await cb(app)
+            assert calls["stop"] == before  # no flush dispatched
+            # Sentinel cleared: the profiler state no longer reads active.
+            r2 = await client.post("/profile/stop")
+            assert r2.status == 409
+            assert "not active" in (await r2.json())["error"]
+            release.set()
+            r0 = await task
+            assert r0.status == 200  # the in-flight stop still completes
+
+        await with_client(app, drive)
+
+    asyncio.run(go())
+
+
 def test_profile_endpoints(tmp_path):
     """POST /profile/start captures a jax.profiler trace of device work done
     while active; double-start and stop-without-start are 409s."""
