@@ -105,6 +105,17 @@ Environment knobs:
     MCPX_BENCH_SPEC_HEADLINE      1 = serve the HEADLINE phases with
                          speculation on too (forces hetero_batch; default 0
                          keeps the headline comparable to earlier rounds)
+    MCPX_BENCH_PREFIX    0 skips the radix prefix KV reuse phase (default
+                         on): the same repeat-heavy intent stream planned
+                         with engine.prefix_cache off vs on →
+                         prefill_tokens_per_request per mode, prefix hit
+                         rates, and COLD vs WARM replan p50 (a warm replan
+                         continues decoding from the cached prefix with the
+                         exclusions spliced into the prompt suffix) in the
+                         output JSON
+    MCPX_BENCH_PREFIX_INTENTS     unique intents in the phase pool (8)
+    MCPX_BENCH_PREFIX_REPS        repeats per unique intent (8)
+    MCPX_BENCH_PREFIX_REPLANS     replans timed per mode (6)
     MCPX_BENCH_OVERLOAD_FACTOR    offered load as a multiple of measured
                                   throughput (default 4)
     MCPX_BENCH_OVERLOAD_REQUESTS  overload-phase request count (default 256)
@@ -1138,6 +1149,173 @@ async def _spec_phase(cp) -> "dict | None":
     }
 
 
+async def _prefix_phase(cp) -> "dict | None":
+    """Radix prefix KV reuse scenario (ISSUE 8 acceptance): the SAME
+    repeat-heavy intent stream planned twice at the same offered load —
+
+      - **off**: ``engine.prefix_cache=false`` — every /plan re-prefills
+        its whole prompt (header + registry shortlist + intent), the
+        pre-radix baseline.
+      - **on**: the radix tree matches each prompt's resident head, pins
+        it, and prefills only the unmatched suffix; the page-aligned
+        remainder is inserted back for the next sharer.
+
+    Direct ``cp.plan(use_cache=False)`` calls (the PLAN cache would
+    short-circuit the repeats this phase exists to measure; prefix reuse
+    is the engine-level answer for exactly the traffic the plan cache
+    can't serve — per-request decode with shared prompt heads). Reports
+    ``prefill_tokens_per_request`` per mode (engine counter deltas — the
+    prefix build's own tokens are billed by the engine, so the ON number
+    is honest amortisation, not hidden cost), the request- and
+    token-level ``prefix_hit_rate``, and COLD vs WARM replan p50: a
+    replan prompt re-rendered over the original service order with the
+    exclusions spliced into the suffix (Avoid line) continues from the
+    cached prefix at incremental-decode cost, vs the prefix-off cold
+    re-plan. The flip is admission-scoped (no executable or page-slack
+    geometry depends on it), so a live engine serves both modes; each
+    mode idles the slab first. Skip with MCPX_BENCH_PREFIX=0."""
+    if os.environ.get("MCPX_BENCH_PREFIX", "1") == "0":
+        return None
+    engine = getattr(cp.planner, "engine", None)
+    if engine is None or engine.state != "ready":
+        return None
+    import random as _random
+
+    from mcpx.utils.synth import intent_for
+
+    ecfg = engine.config.engine
+    records = await cp.registry.list_services()
+    rng = _random.Random(23)
+    n_unique = max(1, int(os.environ.get("MCPX_BENCH_PREFIX_INTENTS", "8")))
+    reps = max(2, int(os.environ.get("MCPX_BENCH_PREFIX_REPS", "8")))
+    n_replans = max(1, int(os.environ.get("MCPX_BENCH_PREFIX_REPLANS", "6")))
+    pool = [f"{intent_for(records, rng)} [pfx{i}]" for i in range(n_unique)]
+    intents = [pool[i % n_unique] for i in range(n_unique * reps)]
+    concurrency = min(engine.config.engine.max_batch_size, 16)
+
+    async def _idle() -> None:
+        while engine._slab.n_active or engine._queue.qsize():
+            await asyncio.sleep(0.05)
+        await asyncio.sleep(0.1)
+
+    def _prom() -> dict:
+        return _parse_prom(cp.metrics.render().decode())
+
+    prev_on = ecfg.prefix_cache
+
+    async def measure(on: bool) -> dict:
+        await _idle()
+        ecfg.prefix_cache = on
+        prom0 = _prom()
+        sem = asyncio.Semaphore(concurrency)
+
+        async def one(intent: str) -> None:
+            async with sem:
+                await cp.plan(intent, use_cache=False)
+
+        t0 = time.monotonic()
+        await asyncio.gather(*(one(i) for i in intents))
+        await _idle()
+        elapsed = time.monotonic() - t0
+        prom1 = _prom()
+
+        def d(name: str) -> float:
+            return prom1.get(name, 0.0) - prom0.get(name, 0.0)
+
+        n = len(intents)
+        hits = d("mcpx_kv_prefix_hits_total")
+        misses = d("mcpx_kv_prefix_misses_total")
+        matched = d("mcpx_kv_prefix_matched_tokens_total")
+        prefilled = d("mcpx_engine_prefill_tokens_total")
+        res = {
+            "requests": n,
+            "plans_per_sec": round(n / max(1e-9, elapsed), 2),
+            "prefill_tokens_per_request": round(prefilled / max(1, n), 1),
+        }
+        if on:
+            res["prefix_hit_rate"] = round(hits / max(1.0, hits + misses), 4)
+            res["prefix_token_hit_rate"] = round(
+                matched / max(1.0, matched + prefilled), 4
+            )
+            res["prefix_shared_pages"] = int(
+                prom1.get("mcpx_kv_prefix_shared_pages", 0.0)
+            )
+        return res
+
+    async def replan_probe(on: bool) -> "dict | None":
+        """REPLAN cost (the planner call plan_and_execute makes after a
+        node failure): warm replans render over the original service order
+        with an Avoid suffix and continue from the cached prefix; cold
+        replans re-prefill everything. Reports wall p50 AND the replan's
+        own prefill bill (the mechanism's direct effect — on a
+        decode-dominated proxy the wall ratio understates it)."""
+        await _idle()
+        ecfg.prefix_cache = on
+        lats: list[float] = []
+        prefilled = 0.0
+        for i in range(n_replans):
+            intent = pool[i % n_unique]
+            plan, _ = await cp.plan(intent, use_cache=False)
+            if not plan.nodes:
+                continue
+            exclude = {plan.nodes[0].service}
+            prior = (
+                tuple(plan.prompt_services)
+                if on and plan.prompt_services
+                else None
+            )
+            ctx = await cp._context(intent, exclude, replan_prior=prior)
+            pf0 = _prom().get("mcpx_engine_prefill_tokens_total", 0.0)
+            t0 = time.monotonic()
+            await cp.planner.plan(intent, ctx)
+            lats.append((time.monotonic() - t0) * 1e3)
+            prefilled += (
+                _prom().get("mcpx_engine_prefill_tokens_total", 0.0) - pf0
+            )
+        if not lats:
+            return None
+        return {
+            "p50_ms": round(statistics.median(lats), 1),
+            "prefill_tokens": round(prefilled / len(lats), 1),
+        }
+
+    try:
+        off = await measure(False)
+        cold = await replan_probe(False)
+        on = await measure(True)
+        warm = await replan_probe(True)
+    finally:
+        ecfg.prefix_cache = prev_on
+    cold_p50 = cold["p50_ms"] if cold else None
+    warm_p50 = warm["p50_ms"] if warm else None
+    out = {
+        "requests": len(intents),
+        "unique_intents": n_unique,
+        "off": off,
+        "on": on,
+        "prefill_tokens_per_request": on["prefill_tokens_per_request"],
+        "prefill_reduction": round(
+            off["prefill_tokens_per_request"]
+            / max(1e-9, on["prefill_tokens_per_request"]),
+            2,
+        ),
+        "prefix_hit_rate": on.get("prefix_hit_rate"),
+        "prefix_token_hit_rate": on.get("prefix_token_hit_rate"),
+        "replan_p50_cold_ms": cold_p50,
+        "replan_p50_warm_ms": warm_p50,
+        "replan_speedup": (
+            round(cold_p50 / warm_p50, 2)
+            if cold_p50 and warm_p50
+            else None
+        ),
+        # The mechanism's direct effect, independent of decode share:
+        # prompt tokens each replan actually re-prefilled.
+        "replan_prefill_tokens_cold": cold["prefill_tokens"] if cold else None,
+        "replan_prefill_tokens_warm": warm["prefill_tokens"] if warm else None,
+    }
+    return out
+
+
 # Span names -> attribution phase keys (tracing spine, mcpx/telemetry/
 # tracing.py). Per request: scheduler queue wait, engine admit-wait
 # (enqueue -> admission prefill start), cohort prefill, slab-resident
@@ -1587,6 +1765,11 @@ async def _run(model_size: str, n_requests: int, concurrency: int, n_services: i
         # direct-engine measurement style; numbered 7 by birth order).
         spec = await _spec_phase(cp)
 
+        # ---- Phase 8: radix prefix KV reuse (ISSUE 8) — after every
+        # headline scrape (it flips engine.prefix_cache live and drives
+        # repeat-intent plans through the serving engine).
+        prefix = await _prefix_phase(cp)
+
         # ---- Phase 5: latency attribution (ISSUE 4) — a traced open-loop
         # sample at the phase-2 rate; runs after every headline scrape
         # because attaching the tracer is the one thing this phase does
@@ -1732,6 +1915,10 @@ async def _run(model_size: str, n_requests: int, concurrency: int, n_services: i
         # baseline) vs on — decode tok/s per mode, the speedup, per-class
         # accept rates, and the greedy byte-parity verdict.
         "spec": spec,
+        # Radix prefix KV reuse scenario (None when skipped): prefill
+        # tokens/request and replan p50 with the prefix cache off vs on
+        # over a repeat-heavy intent stream at the same offered load.
+        "prefix": prefix,
         # Per-phase latency attribution from sampled request traces (None
         # when skipped): p50/p99 of scheduler-queue vs engine admit-wait vs
         # prefill vs decode vs tool fan-out, plus each phase's share of the
@@ -2149,6 +2336,30 @@ def _output_json(stats: dict, quality_trained, model: str) -> dict:
                 ),
                 "spec_accept_rate": (
                     stats["spec"]["spec_accept_rate"] if stats["spec"] else None
+                ),
+                "prefix": stats["prefix"],
+                # Acceptance keys promoted to the top level (ISSUE 8): the
+                # same repeat-heavy stream planned with the radix prefix
+                # cache off vs on, plus cold-vs-warm replan p50.
+                "prefill_tokens_per_request": (
+                    stats["prefix"]["prefill_tokens_per_request"]
+                    if stats["prefix"] else None
+                ),
+                "prefill_reduction": (
+                    stats["prefix"]["prefill_reduction"]
+                    if stats["prefix"] else None
+                ),
+                "prefix_hit_rate": (
+                    stats["prefix"]["prefix_hit_rate"]
+                    if stats["prefix"] else None
+                ),
+                "replan_p50_cold_ms": (
+                    stats["prefix"]["replan_p50_cold_ms"]
+                    if stats["prefix"] else None
+                ),
+                "replan_p50_warm_ms": (
+                    stats["prefix"]["replan_p50_warm_ms"]
+                    if stats["prefix"] else None
                 ),
                 "latency_attribution": stats["latency_attribution"],
                 "chaos": stats["chaos"],

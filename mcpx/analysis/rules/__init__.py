@@ -4,6 +4,7 @@ new rule — see docs/static-analysis.md."""
 
 from mcpx.analysis.rules import (  # noqa: F401
     async_rules,
+    cache_rules,
     jax_rules,
     metrics_rules,
     resilience_rules,

@@ -1,0 +1,119 @@
+"""Cache-hygiene rule: unbounded cache growth in the request path.
+
+The bug class: a dict/list used as a cache ("cache"/"memo" in its name)
+that a request-path async function INSERTS into without any eviction or
+size-bound consult in the same scope. Every request leaks an entry; the
+process grows until the OOM killer finds it — silent in tests (bounded
+request counts) and fatal in production. The radix prefix KV cache PR is
+exactly this shape done right (engine/prefix_cache.py: every insertion
+path consults ``evict()`` and a budget), and this rule keeps the next
+cache honest.
+
+What counts as an insertion (on a cache-named container):
+
+  - ``X[key] = value`` (subscript assign, incl. augmented; a LITERAL key
+    is exempt — ``stats_cache["hits"] += 1`` is a fixed slot, not growth)
+  - ``X.append(v)`` / ``X.add(v)`` / ``X.setdefault(k, v)`` / ``X.insert(...)``
+
+What counts as a bound consult (same function scope, same container —
+or any call whose name mentions eviction):
+
+  - ``X.pop`` / ``X.popitem`` / ``X.clear`` / ``X.evict``
+  - ``del X[...]``
+  - ``len(X)`` anywhere (a size check implies a bound decision)
+  - a call to anything whose name contains "evict" (``self._evict_…``)
+
+Scope: async functions only — this codebase's request path is async end
+to end; sync worker-thread code (the engine) manages its caches under
+explicit budgets and single-writer discipline. Containers without a
+cache-ish name stay silent: flagging every dict write would bury the
+real leaks.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from mcpx.analysis.core import FileContext, Finding, rule
+from mcpx.analysis.rules.common import async_functions, call_name, dotted_name, walk_scope
+
+_INSERT_METHODS = {"append", "add", "setdefault", "insert"}
+_CONSULT_METHODS = {"pop", "popitem", "clear", "evict"}
+
+
+def _cache_named(name: Optional[str]) -> bool:
+    if not name:
+        return False
+    last = name.rsplit(".", 1)[-1].lower()
+    return "cache" in last or "memo" in last
+
+
+def _insertions(fn) -> Iterator[tuple[int, str]]:
+    """(lineno, container dotted name) for every cache insertion in fn."""
+    for node in walk_scope(fn):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Subscript):
+                    if isinstance(t.slice, ast.Constant):
+                        # A literal key ("hits", 0) is a fixed slot —
+                        # counters and stat dicts cannot grow per request.
+                        continue
+                    name = dotted_name(t.value)
+                    if _cache_named(name):
+                        yield node.lineno, name
+        elif isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if node.func.attr in _INSERT_METHODS:
+                name = dotted_name(node.func.value)
+                if _cache_named(name):
+                    yield node.lineno, name
+
+
+def _consulted(fn, container: str) -> bool:
+    """True when the function scope bounds ``container`` somewhere: an
+    eviction-ish method call, a ``del``, a ``len()`` size check, or any
+    call whose name mentions eviction."""
+    for node in walk_scope(fn):
+        if isinstance(node, ast.Call):
+            fname = call_name(node)
+            if fname == "len" and node.args:
+                if dotted_name(node.args[0]) == container:
+                    return True
+            if fname is not None and "evict" in fname.rsplit(".", 1)[-1].lower():
+                return True
+            if isinstance(node.func, ast.Attribute):
+                if (
+                    node.func.attr in _CONSULT_METHODS
+                    and dotted_name(node.func.value) == container
+                ):
+                    return True
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript) and dotted_name(t.value) == container:
+                    return True
+    return False
+
+
+@rule(
+    "unbounded-cache-growth",
+    "Cache insertion in a request-path async function with no eviction "
+    "or size-bound consult in scope",
+)
+def check_unbounded_cache_growth(ctx: FileContext) -> Iterator[Finding]:
+    for fn in async_functions(ctx.tree):
+        flagged: set[tuple[int, str]] = set()
+        for lineno, container in _insertions(fn):
+            if (lineno, container) in flagged:
+                continue
+            if _consulted(fn, container):
+                continue
+            flagged.add((lineno, container))
+            yield ctx.finding(
+                lineno,
+                "unbounded-cache-growth",
+                f"'{container}' grows by one entry per call of async "
+                f"'{fn.name}' with no eviction/size-bound consult in scope "
+                "— a per-request memory leak; bound it (LRU popitem, "
+                "len() cap, evict()) or insert via a bounded helper",
+            )

@@ -53,6 +53,9 @@ TRACKED_METRICS: tuple[tuple[str, str, Optional[str]], ...] = (
     ("mfu", "higher", "mfu_basis"),
     ("mixed.speedup", "higher", None),
     ("spec_speedup", "higher", None),
+    ("prefill_tokens_per_request", "lower", None),
+    ("prefix_hit_rate", "higher", None),
+    ("replan_p50_warm_ms", "lower", None),
     ("chaos_success_rate", "higher", None),
     ("deadline_overrun_share", "lower", None),
     ("plan_quality_trained.score", "higher", None),

@@ -66,6 +66,7 @@ from mcpx.core.config import MCPXConfig
 from mcpx.core.errors import EngineError
 from mcpx.engine.kv_cache import PageAllocator, commit_prefill_to_pages, init_paged_kv
 from mcpx.engine.paged_decode import decode_chunk_paged
+from mcpx.engine.prefix_cache import PrefixNode, RadixPrefixCache
 from mcpx.engine.sampling import accept_rows, sample, sample_rows, sample_window_rows
 from mcpx.engine.speculative import advance_drafter_state, draft_window
 from mcpx.models.gemma.config import GemmaConfig
@@ -80,6 +81,7 @@ from mcpx.planner.grammar import (
     stacked_spec_tables,
 )
 from mcpx.scheduler.admission import ewma_update
+from mcpx.scheduler.locality import locality_order
 from mcpx.telemetry import tracing
 from mcpx.telemetry.costs import CostRegistry, device_peaks, rounded_roofline
 from mcpx.telemetry.metrics import Metrics
@@ -103,8 +105,16 @@ class GenerateRequest:
     # The first `shared_prefix_len` prompt ids are identical across many
     # requests (the planner's fixed prompt header): the engine prefills them
     # ONCE into read-only KV pages shared by every row's page table, and
-    # per-request prefill covers only the suffix. 0 disables.
+    # per-request prefill covers only the suffix. 0 disables. With the
+    # radix prefix cache this is a cold-start HINT (the declared head is
+    # pre-built into the tree before the first cohort so even that cohort
+    # shares it); matching itself is per-request against the whole tree.
     shared_prefix_len: int = 0
+    # EDF deadline (time.monotonic timestamp) from the serving scheduler:
+    # the locality-aware admission sort must never regroup a request whose
+    # deadline cannot afford the wait (scheduler/locality.py). None = no
+    # deadline (reorderable freely within the fairness-age bound).
+    deadline_at: Optional[float] = None
     # Tracing parent (telemetry/tracing.Span) for engine-side attribution:
     # the worker thread hangs queue-wait / prefill / per-segment decode
     # child spans off it via explicit parent.child(t0=..., t1=...) calls —
@@ -125,16 +135,23 @@ class GenerateRequest:
 
 
 @dataclasses.dataclass
-class _Prefix:
-    """A cached, prefilled prompt head: `n_tokens` of KV living in `pages`
-    (read-only — rows reference these pages but only ever write at
-    positions >= n_tokens, which land in their own pages). `refs` counts
-    resident rows using it; eviction requires refs == 0."""
+class _PinPrefixOp:
+    """Worker-queue control op: pin the deepest resident radix node whose
+    path prefixes ``ids`` (a ``/plan_and_execute`` holding its plan's
+    prompt KV warm across tool execution); resolves ``future`` with the
+    node handle, or None when nothing is resident. Single-writer: the
+    worker thread applies it between segments."""
 
-    sid: tuple
-    pages: list[int]
-    n_tokens: int
-    refs: int = 0
+    ids: list[int]
+    future: "asyncio.Future[Optional[PrefixNode]]"
+    loop: asyncio.AbstractEventLoop
+
+
+@dataclasses.dataclass
+class _UnpinPrefixOp:
+    """Worker-queue control op: release a ``_PinPrefixOp`` pin."""
+
+    node: PrefixNode
 
 
 @dataclasses.dataclass
@@ -180,7 +197,13 @@ class _Slab:
         self.pad_id = pad_id
         self.req: list[Optional[GenerateRequest]] = [None] * B
         self.sid: list[Optional[tuple]] = [None] * B
-        self.prefix: list[Optional["_Prefix"]] = [None] * B
+        # Radix prefix nodes this row pins (engine/prefix_cache.py): the
+        # deepest matched node plus the node inserted for the row's own
+        # page-aligned prompt remainder. refs released at clear_row.
+        self.prefix: list[tuple] = [()] * B
+        # Matched-prefix tokens per row (admission-time): the
+        # engine.prefill span's prefix_matched_tokens/prefix_hit attrs.
+        self.prefix_toks = np.zeros((B,), np.int32)
         # Per-row generation counter, bumped at admission. In-flight segment
         # outputs carry a snapshot: a done-flag from a segment dispatched
         # BEFORE the row was re-admitted must never retire the row's NEW
@@ -305,9 +328,10 @@ class _Slab:
         self.hstate[i, :] = 0.0
         self.gen[i] += 1
         self.page_table[i, :] = 0
-        if self.prefix[i] is not None:
-            self.prefix[i].refs -= 1
-            self.prefix[i] = None
+        for node in self.prefix[i]:
+            node.refs -= 1
+        self.prefix[i] = ()
+        self.prefix_toks[i] = 0
 
 
 # Legal lifecycle transitions: the single source of truth for the engine
@@ -353,7 +377,6 @@ class InferenceEngine:
         self._paged_kv = None
         self._seq_mesh = None
         self._dfa_cache: "OrderedDict[tuple, tuple]" = OrderedDict()
-        self._prefix_cache: "OrderedDict[tuple, _Prefix]" = OrderedDict()
         # Heterogeneous batching (EngineConfig.hetero_batch): the stacked-DFA
         # slot table. ``_dfa_slots[k]`` is the grammar whose padded tables
         # occupy stack index k (slot 0 = trivial all-accept DFA, None = free
@@ -409,6 +432,20 @@ class InferenceEngine:
             page_size=ecfg.kv_page_size,
             max_pages_per_seq=ecfg.max_pages_per_seq,
         )
+        # Radix-tree prefix KV cache (engine/prefix_cache.py): cross-request
+        # prompt-head reuse over the paged pool. Worker-thread-owned after
+        # start; counters are read cross-thread (queue_stats, GET /cache).
+        self._prefix_cache = RadixPrefixCache(
+            self._allocator,
+            ecfg.kv_page_size,
+            max_nodes=max(0, ecfg.prefix_cache_entries),
+        )
+        # Last-synced cache counters -> Prometheus (the worker folds deltas
+        # into mcpx_kv_prefix_* once per iteration, so the cache itself
+        # stays metrics-free and single-purpose).
+        self._prefix_seen = {
+            "hits": 0, "misses": 0, "evictions": 0, "matched_tokens": 0,
+        }
         self._prefill_buckets = tuple(
             b
             for b in (64, 128, 256, 512, 768, 1024, 1536, 2048)
@@ -561,7 +598,7 @@ class InferenceEngine:
             self._inflight.clear()
             self._pending_admissions.clear()
             self._dfa_cache.clear()
-            self._prefix_cache.clear()
+            self._prefix_cache.drop_all()
         else:
             log.warning(
                 "engine worker still alive after %.1fs join timeout; keeping "
@@ -580,6 +617,7 @@ class InferenceEngine:
         temperature: Optional[float] = None,
         grammar: Optional[PlanGrammar] = None,
         shared_prefix_len: int = 0,
+        deadline_at: Optional[float] = None,
     ) -> GenerateResult:
         if self.state != "ready":
             raise EngineError(f"engine not ready (state={self.state})")
@@ -599,6 +637,7 @@ class InferenceEngine:
                 enqueued_at=time.monotonic(),
                 grammar=grammar,
                 shared_prefix_len=shared_prefix_len if ecfg.prefix_cache else 0,
+                deadline_at=deadline_at,
                 span=esp,
             )
             self._queue.put(req)
@@ -611,6 +650,37 @@ class InferenceEngine:
                     decode_ms=round(res.decode_ms, 3),
                 )
             return res
+
+    async def pin_prefix(self, prompt_ids: list[int]) -> Optional[PrefixNode]:
+        """Pin the deepest resident radix-tree node whose path prefixes
+        ``prompt_ids`` so eviction cannot reclaim it; returns an opaque
+        handle for ``unpin_prefix`` (None when nothing is resident, the
+        cache is off, or the engine is not serving). The structured
+        ``/plan_and_execute`` program uses this to keep its plan's prompt
+        KV warm across tool execution, so a failure-triggered replan
+        continues decoding from the cached prefix instead of cold
+        re-prefilling."""
+        if self.state != "ready" or not self.config.engine.prefix_cache:
+            return None
+        loop = asyncio.get_running_loop()
+        fut: "asyncio.Future[Optional[PrefixNode]]" = loop.create_future()
+        self._queue.put(_PinPrefixOp(list(prompt_ids), fut, loop))
+        return await fut
+
+    def unpin_prefix(self, handle: Optional[PrefixNode]) -> None:
+        """Release a ``pin_prefix`` pin (idempotent for None; fire-and-
+        forget — the worker applies it at its next queue drain)."""
+        if handle is None or self.state == "closed":
+            return
+        self._queue.put(_UnpinPrefixOp(handle))
+
+    def prefix_cache_stats(self) -> dict:
+        """Cross-thread counter snapshot of the radix prefix cache (the
+        ``GET /cache`` surface); ``enabled`` reflects the live config."""
+        return {
+            "enabled": bool(self.config.engine.prefix_cache),
+            **self._prefix_cache.stats(),
+        }
 
     def queue_stats(self) -> dict:
         """Cross-thread snapshot of engine load for the serving scheduler
@@ -646,7 +716,15 @@ class InferenceEngine:
         sp = self._spec_totals
         drafted = sp["drafted_constrained"] + sp["drafted_free"]
         accepted = sp["accepted_constrained"] + sp["accepted_free"]
+        # Prefix scoreboard (radix KV cache): resident-tree size and hit
+        # rates — what the locality-aware admission sort is working with,
+        # published for the serving scheduler and /healthz.
+        ps_pfx = self._prefix_cache.stats()
         return {
+            "prefix_nodes": ps_pfx["nodes"],
+            "prefix_resident_pages": ps_pfx["resident_pages"],
+            "prefix_hit_rate": ps_pfx["hit_rate"],
+            "prefix_token_hit_rate": ps_pfx["token_hit_rate"],
             "depth": depth,
             "active": active,
             "service_ewma_s": svc,
@@ -1451,11 +1529,24 @@ class InferenceEngine:
                     # cohort prefill this row rode in, commit-to-pages and
                     # first sample (observed <=1 tick late, same as the
                     # prefill_ms it mirrors).
+                    # prefix_* attrs: latency attribution (PR 4) separates
+                    # warm prefill (radix-matched head, suffix-only work)
+                    # from cold — attached only while the cache is enabled
+                    # so disabled-mode span payloads stay byte-identical.
+                    pfx_attrs = (
+                        {
+                            "prefix_matched_tokens": int(slab.prefix_toks[i]),
+                            "prefix_hit": bool(slab.prefix_toks[i] > 0),
+                        }
+                        if self.config.engine.prefix_cache
+                        else {}
+                    )
                     r.span.child(
                         "engine.prefill",
                         t0=t_admit0,
                         t1=now,
                         dfa_id=int(slab.dfa[i]),
+                        **pfx_attrs,
                         # XLA-derived roofline of the cohort prefill this
                         # row rode in (whole-cohort cost over the chain's
                         # wall window — per-row attribution would be a lie).
@@ -1805,85 +1896,98 @@ class InferenceEngine:
         )
         return last, kv["k"], kv["v"]
 
-    def _ensure_prefix(self, key: tuple) -> Optional["_Prefix"]:
-        """Return the cached prefilled prompt head for ``key``, building it
-        on miss (one [1, T] prefill into dedicated pages). None when it
-        cannot be built right now (page pressure, capacity) — callers fall
-        back to full prefill. Worker-thread only."""
+    def _ensure_prefix(self, key: tuple) -> Optional[PrefixNode]:
+        """Make the declared shared prompt head ``key`` fully resident in
+        the radix tree, prefilling only the part the tree does not already
+        hold (one [1, T] dispatch — suffix-offset when a head is matched,
+        dense full prefill from zero). Returns the deepest node covering
+        ``key`` (unpinned), or None when it cannot be built right now (page
+        pressure, capacity) — per-row matching then reuses whatever IS
+        resident. This pre-build exists so even the FIRST cohort of a burst
+        shares its declared header instead of prefilling it once per row.
+        Worker-thread only."""
         ecfg = self.config.engine
-        hit = self._prefix_cache.get(key)
-        if hit is not None:
-            self._prefix_cache.move_to_end(key)
-            self.metrics.prefix_hits.inc()
-            return hit
+        cache = self._prefix_cache
         P = len(key)
         capacity = ecfg.max_pages_per_seq * ecfg.kv_page_size
+        n, _pages, mnode = cache.match(key, cap=P, record=False)
+        if n == P:
+            return mnode
         # The prefix must leave room for a minimal suffix + decode budget,
-        # and must itself fit a prefill bucket — checked BEFORE any pages
-        # are allocated (a raise here must not leak).
-        eligible = tuple(b for b in self._prefill_buckets if b <= capacity)
+        # and its unmatched remainder must fit a prefill bucket — checked
+        # BEFORE any pages are allocated (a raise here must not leak).
+        R = P - n
+        eligible = tuple(b for b in self._prefill_buckets if b + n <= capacity)
         if (
             not eligible
-            or P > eligible[-1]
+            or R > eligible[-1]
             or P + self._prefill_buckets[0] + ecfg.max_decode_len > capacity
         ):
             return None
-        T = _bucket(P, eligible)
-        if not self._allocator.can_allocate(P):
-            self._evict_prefixes(P)
-            if not self._allocator.can_allocate(P):
-                return None
-        self.metrics.prefix_misses.inc()
-        self._seq_counter += 1
-        sid = ("prefix", self._seq_counter)
-        pages = self._allocator.allocate(sid, P)
+        T = _bucket(R, eligible)
+        if mnode is not None:
+            mnode.refs += 1  # hold: the build below may evict under pressure
+        node = cache.insert(key, n, R)
+        if mnode is not None:
+            mnode.refs -= 1
+        if node is None:
+            return None
         table = np.zeros((1, ecfg.max_pages_per_seq), np.int32)
-        table[0, : len(pages)] = pages
+        table[0, : n // ecfg.kv_page_size] = _pages
+        table[0, n // ecfg.kv_page_size : P // ecfg.kv_page_size] = node.pages
         tokens = np.full((1, T), self.tokenizer.pad_id, np.int32)
-        tokens[0, :P] = key
+        tokens[0, :R] = key[n:]
         try:
-            # Long shared prefixes are the prime ring workload — route them
-            # like any full prefill (B=1 rides the seq mesh's size-1 data
-            # axis replicated).
-            use_ring = self._ring_ok(T)
-            if use_ring:
-                self.metrics.ring_prefills.inc()
-            last, k_p, v_p = self._jit_prefill(
-                self._params,
-                self._put(tokens, self._row_spec(1, 1)),
-                self._put(np.asarray([P], np.int32), self._row_spec(1)),
-                self._paged_kv["k"],
-                self._paged_kv["v"],
-                self._put(table, self._row_spec(1, 1)),
-                T=T,
-                ring=use_ring,
-            )
+            if n > 0:
+                # Continue from the resident head: prefill only [n, P).
+                last, k_p, v_p = self._jit_suffix_prefill(
+                    self._params,
+                    self._put(tokens, self._row_spec(1, 1)),
+                    self._put(np.asarray([R], np.int32), self._row_spec(1)),
+                    self._put(np.asarray([n], np.int32), self._row_spec(1)),
+                    self._put(table, self._row_spec(1, 1)),
+                    self._paged_kv["k"],
+                    self._paged_kv["v"],
+                )
+            else:
+                # Long shared prefixes are the prime ring workload — route
+                # them like any full prefill (B=1 rides the seq mesh's
+                # size-1 data axis replicated).
+                use_ring = self._ring_ok(T)
+                if use_ring:
+                    self.metrics.ring_prefills.inc()
+                last, k_p, v_p = self._jit_prefill(
+                    self._params,
+                    self._put(tokens, self._row_spec(1, 1)),
+                    self._put(np.asarray([R], np.int32), self._row_spec(1)),
+                    self._paged_kv["k"],
+                    self._paged_kv["v"],
+                    self._put(table, self._row_spec(1, 1)),
+                    T=T,
+                    ring=use_ring,
+                )
             self._paged_kv = {"k": k_p, "v": v_p}
             del last
         except BaseException:
-            self._allocator.free(sid)
+            cache.rollback(node)
             raise
-        pfx = _Prefix(sid=sid, pages=pages, n_tokens=P)
-        self._prefix_cache[key] = pfx
-        self._evict_prefixes(exclude=key)
-        return pfx
+        # The build counts as prefill work (amortised once per resident
+        # prefix, not per request) — the bench's prefill-tokens-per-request
+        # accounting must see it or reuse would overstate itself.
+        self.metrics.prefill_tokens.inc(R)
+        cache.seal()  # dispatched: later cohorts may read these pages
+        node.refs -= 1  # drop the insert's born-pin; callers re-pin
+        return node
 
-    def _evict_prefixes(self, need_tokens: int = 0, exclude: Optional[tuple] = None) -> None:
-        """Drop unreferenced cached prefixes (LRU first) while over the
-        entry cap, or until ``need_tokens`` worth of pages can be allocated.
-        ``exclude`` protects a just-built, not-yet-referenced entry from
-        being evicted before its caller can use it."""
-        max_entries = max(0, self.config.engine.prefix_cache_entries)
-        for key in list(self._prefix_cache):
-            over = len(self._prefix_cache) > max_entries
-            starved = need_tokens and not self._allocator.can_allocate(need_tokens)
-            if not (over or starved):
-                return
-            pfx = self._prefix_cache[key]
-            if pfx.refs > 0 or key == exclude:
-                continue
-            self._allocator.free(pfx.sid)
-            del self._prefix_cache[key]
+    def _evict_prefixes(self, need_tokens: int = 0) -> None:
+        """Reclaim refcount-0 radix subtrees (LRU leaves first) while over
+        the node cap or until ``need_tokens`` worth of pages can be
+        allocated. The cap is re-read from config so a live operator tune
+        (or a test forcing full eviction) takes effect immediately."""
+        self._prefix_cache.max_nodes = max(
+            0, self.config.engine.prefix_cache_entries
+        )
+        self._prefix_cache.evict(need_tokens)
 
     def _segment_impl(
         self,
@@ -2697,7 +2801,10 @@ class InferenceEngine:
                 r = self._queue.get_nowait()
             except queue.Empty:
                 break
-            if r is not None:
+            if isinstance(r, _PinPrefixOp):
+                # A pin racing shutdown resolves to "nothing resident".
+                r.loop.call_soon_threadsafe(_resolve, r.future, None, None)
+            elif r is not None and not isinstance(r, _UnpinPrefixOp):
                 r.loop.call_soon_threadsafe(_resolve, r.future, None, closed)
 
     def _refresh_queue_gauges(self, pending: "deque[GenerateRequest]") -> None:
@@ -2718,6 +2825,25 @@ class InferenceEngine:
         }
         self.metrics.queue_depth_class.labels(cls="constrained").set(n_cons)
         self.metrics.queue_depth_class.labels(cls="free").set(n_free)
+        # Radix prefix-cache counters -> Prometheus, as deltas so the cache
+        # itself stays metrics-free (one sync point, no double counting).
+        c = self._prefix_cache
+        seen = self._prefix_seen
+        for attr, metric in (
+            ("hits", self.metrics.prefix_hits),
+            ("misses", self.metrics.prefix_misses),
+            ("evictions", self.metrics.prefix_evictions),
+            ("matched_tokens", self.metrics.prefix_matched_tokens),
+        ):
+            cur = getattr(c, attr)
+            if cur > seen[attr]:
+                metric.inc(cur - seen[attr])
+                seen[attr] = cur
+            elif cur < seen[attr]:  # rollback reversed an insert/eviction
+                seen[attr] = cur
+        self.metrics.prefix_shared_pages.set(
+            c.resident_tokens // max(1, c.page_size)
+        )
 
     def _drain_queue(self, pending: "deque[GenerateRequest]", block: bool) -> None:
         """Move queued requests into ``pending``. When idle (``block``), wait
@@ -2733,7 +2859,8 @@ class InferenceEngine:
             if item is None:
                 self._stop = True
                 return
-            pending.append(item)
+            if not self._apply_prefix_op(item):
+                pending.append(item)
             try:
                 item = self._queue.get_nowait()
             except queue.Empty:
@@ -2751,7 +2878,26 @@ class InferenceEngine:
                 if item is None:
                     self._stop = True
                     return
-                pending.append(item)
+                if not self._apply_prefix_op(item):
+                    pending.append(item)
+
+    def _apply_prefix_op(self, item: Any) -> bool:
+        """Apply a radix-tree control op riding the request queue (pin /
+        unpin from the event loop); returns whether ``item`` was one.
+        Worker thread only — the single-writer discipline is exactly why
+        pins travel through the queue instead of touching the tree
+        cross-thread."""
+        if isinstance(item, _PinPrefixOp):
+            node = self._prefix_cache.lookup(item.ids)
+            if node is not None:
+                node.refs += 1
+            item.loop.call_soon_threadsafe(_resolve, item.future, node, None)
+            return True
+        if isinstance(item, _UnpinPrefixOp):
+            if item.node.refs > 0:
+                item.node.refs -= 1
+            return True
+        return False
 
     def _admit(self, slab: "_Slab", pending: "deque[GenerateRequest]") -> None:
         """Admit pending requests into free slab rows: prefill the cohort,
@@ -2818,8 +2964,13 @@ class InferenceEngine:
             # limited to one per admit_max_wait_s, full ones go immediately.
             return
 
-    # --- shared-prefix resolution (the cohort shares one prefix key; the
-    # planner's fixed prompt header makes this the common case)
+    # --- prefix locality + declared-head pre-build ------------------------
+        # Locality-aware admission (radix prefix cache): group cohort
+        # admits by shared-prefix depth against the resident tree so
+        # co-resident rows maximise sharing — EDF/age-guarded so the
+        # serving scheduler's deadline ordering survives the regroup.
+        if ecfg.prefix_cache:
+            self._locality_sort(slab, pending)
         if hetero:
             head_req = next((r for r in pending if not r.future.cancelled()), None)
         else:
@@ -2833,43 +2984,81 @@ class InferenceEngine:
         # ahead of the prefills.
         if self._dirty_rows:
             self._dispatch_merge(slab, [])
-        prefix: Optional[_Prefix] = None
+        hold: Optional[PrefixNode] = None
         head_key = (
             head_req.prefix_key(ecfg.kv_page_size) if ecfg.prefix_cache else None
         )
         if head_key is not None:
+            # Cold-start sharing: make the DECLARED shared head resident in
+            # the radix tree before the cohort prefills, so even the first
+            # burst's rows share it instead of each prefilling its own copy
+            # (per-row matching below picks it up like any resident path).
             try:
-                prefix = self._ensure_prefix(head_key)
+                hold = self._ensure_prefix(head_key)
             except BaseException as e:  # noqa: BLE001 - prefill donated pools
                 log.exception("prefix build failed; failing resident rows")
                 self._fail_rows(slab, e)
                 self._reset_pools()
                 return
-            if prefix is None:
-                head_key = None  # unbuildable now (pages/capacity): full path
-        if prefix is not None:
+        if hold is not None:
             # Admission hold: page-pressure eviction inside the cohort loop
-            # must never free the prefix this very admission is wiring into
-            # page tables (rows take their own refs only at merge time).
-            prefix.refs += 1
+            # must never free the head this very admission is wiring into
+            # page tables (rows take their own refs only as they commit).
+            hold.refs += 1
         try:
-            self._admit_cohort(slab, pending, prefix, head_key)
+            self._admit_cohort(slab, pending)
         finally:
-            if prefix is not None:
-                prefix.refs -= 1
+            if hold is not None:
+                hold.refs -= 1
+
+    def _locality_sort(
+        self, slab: "_Slab", pending: "deque[GenerateRequest]"
+    ) -> None:
+        """Reorder the pending line by shared-prefix depth against the
+        resident radix tree (deepest first — those rows prefill almost
+        nothing and their pins keep the shared subtree warm), via the
+        EDF-safe sort in scheduler/locality.py: over-age requests and
+        requests whose deadline cannot afford a regroup keep strict
+        earliest-deadline-first order at the front. Stable, so an empty
+        tree reproduces arrival order byte-for-byte; bounded to a window
+        of 4 slabs' worth so a deep backlog costs O(window) probes, not
+        O(queue)."""
+        if len(pending) < 2 or not self._prefix_cache.n_nodes:
+            return
+        window = min(len(pending), 4 * slab.B)
+        items = list(pending)
+        head, tail = items[:window], items[window:]
+        cache = self._prefix_cache
+        ordered = locality_order(
+            head,
+            now=time.monotonic(),
+            depth_of=lambda r: cache.probe(r.prompt_ids),
+            enqueued_of=lambda r: r.enqueued_at,
+            deadline_of=lambda r: r.deadline_at,
+            age_cap_s=self.config.engine.fairness_timeout_s,
+            # A non-urgent request must tolerate roughly one regrouped
+            # cohort wave: two service intervals plus dispatch noise.
+            deadline_slack_s=2.0 * self._ewma_service_s + 0.05,
+        )
+        # Identity compare: "did the order change" — the dataclass __eq__
+        # would diff prompt_ids element-wise per displaced pair.
+        if any(a is not b for a, b in zip(ordered, head)):
+            pending.clear()
+            pending.extend(ordered)
+            pending.extend(tail)
 
     def _admit_cohort(
         self,
         slab: "_Slab",
         pending: "deque[GenerateRequest]",
-        prefix: Optional["_Prefix"],
-        head_key: Optional[tuple],
     ) -> None:
         ecfg = self.config.engine
         tok = self.tokenizer
         hetero = slab.hetero  # the latched admission mode, not the live flag
         free = slab.free_rows()
-        P = prefix.n_tokens if prefix is not None else 0
+        cache = self._prefix_cache
+        use_prefix = bool(ecfg.prefix_cache)
+        psz = ecfg.kv_page_size
 
     # --- per-request geometry
         # Hetero slabs always run the constrained-width chunk (the segment
@@ -2884,15 +3073,9 @@ class InferenceEngine:
             spec_chunk = self._spec_chunk(True if hetero else slab.constrained)
         slack = spec_chunk if spec_chunk > 1 else 0
         capacity = ecfg.max_pages_per_seq * ecfg.kv_page_size
-        budget_cap = min(slab.steps, capacity - 1 - slack - P)
-        eligible = tuple(b for b in self._prefill_buckets if b + P <= capacity)
-        if (budget_cap < 1 or not eligible) and prefix is not None:
-            # The prefix left no room for suffix + decode on this geometry:
-            # serve without it rather than failing the queue.
-            prefix, head_key, P = None, None, 0
-            budget_cap = min(slab.steps, capacity - 1 - slack)
-            eligible = tuple(b for b in self._prefill_buckets if b <= capacity)
-        if budget_cap < 1 or not eligible:
+        base_budget_cap = min(slab.steps, capacity - 1 - slack)
+        base_eligible = tuple(b for b in self._prefill_buckets if b <= capacity)
+        if base_budget_cap < 1 or not base_eligible:
             err = EngineError(
                 f"page capacity {capacity} (max_pages_per_seq*kv_page_size) "
                 f"cannot fit any decode budget/prefill bucket"
@@ -2902,24 +3085,16 @@ class InferenceEngine:
                 r.loop.call_soon_threadsafe(_resolve, r.future, None, err)
             return
 
-        cohort: list[GenerateRequest] = []
-        prompts: list[list[int]] = []  # SUFFIX ids (whole prompt when P == 0)
-        budgets: list[int] = []
-        slots: list[int] = []  # stacked-DFA slot per cohort member (hetero)
+    # --- stage 1: candidate scan (prefix-independent admission gates)
+        cands: list[tuple[GenerateRequest, int]] = []
         reserved: set[int] = set()
         defer: list[GenerateRequest] = []
-        while pending and len(cohort) < len(free):
+        while pending and len(cands) < len(free):
             r = pending.popleft()
             if r.future.cancelled():
                 # Abandoned while queued (client disconnect / timeout):
                 # skipping here saves the prefill compute and pages that
                 # _reap_cancelled would otherwise claw back a tick later.
-                continue
-            if head_key is not None and r.prefix_key(ecfg.kv_page_size) != head_key:
-                # Different shared prefix: wait for a later cohort (prefix
-                # only shapes ADMISSION; rows with different prefixes decode
-                # side by side just fine).
-                defer.append(r)
                 continue
             if hetero:
                 slot = 0
@@ -2950,32 +3125,167 @@ class InferenceEngine:
                 continue
             else:
                 slot = 0
-            budget = max(1, min(r.max_new_tokens, budget_cap))
-            # Keep the prompt HEAD on overflow — the planner ranks its best
-            # candidate services first and trims the tail, and the engine
-            # must agree (VERDICT r2 weak #4: two layers, two policies).
-            longest = min(eligible[-1], capacity - P - budget - slack)
+            cands.append((r, slot))
+
+        def _geometry(r: GenerateRequest, P: int) -> tuple[int, list[int]]:
+            """(decode budget, suffix ids) for ``r`` admitted at matched
+            depth ``P``. Keeps the prompt HEAD on overflow — the planner
+            ranks its best candidate services first and trims the tail,
+            and the engine must agree (VERDICT r2 weak #4)."""
+            budget = max(
+                1, min(r.max_new_tokens, min(slab.steps, capacity - 1 - slack - P))
+            )
+            elig_last = max(b for b in base_eligible if b + P <= capacity)
+            longest = min(elig_last, capacity - P - budget - slack)
             ids = r.prompt_ids[P : P + longest] or [tok.bos_id]
-            need = len(ids) + budget + slack
+            return budget, ids
+
+        def _usable_depth(r: GenerateRequest, cap_tokens: int) -> int:
+            """Matched depth for ``r`` under ``cap_tokens``, degraded to 0
+            when that depth leaves no room for a decode budget or any
+            prefill bucket (serve without reuse rather than failing)."""
+            if not use_prefix or cap_tokens <= 0:
+                return 0
+            P = cache.probe(
+                r.prompt_ids,
+                min(cap_tokens, cache.match_cap(len(r.prompt_ids))),
+            )
+            if P <= 0:
+                return 0
+            if min(slab.steps, capacity - 1 - slack - P) < 1 or not any(
+                b + P <= capacity for b in base_eligible
+            ):
+                return 0
+            return P
+
+    # --- stage 2: prefill-bucket fix-point over the candidate plans.
+        # Per-row matched depths and the cohort's (shared) prefill bucket T
+        # are mutually dependent: suffix-prefill pad positions index the
+        # page table at (P + t)//page_size for t < T, so every row must
+        # satisfy P + T <= capacity — but shrinking a row's P grows its
+        # suffix, which can grow T. Iterate: plan under a T limit, recompute
+        # the T the plan needs, restart if it grew. T is bucket-quantised
+        # and monotone non-decreasing, so this terminates within
+        # len(buckets) passes of pure host bookkeeping (read-only probes).
+        T = base_eligible[0]
+        planned: list[tuple[int, int, list[int]]] = []  # (P, budget, ids)
+        while True:
+            planned = []
+            worst = 1
+            for r, _slot in cands:
+                P = _usable_depth(r, capacity - T)
+                budget, ids = _geometry(r, P)
+                planned.append((P, budget, ids))
+                worst = max(worst, len(ids))
+            T_needed = _bucket(worst, base_eligible)
+            if T_needed <= T:
+                break
+            T = T_needed
+
+    # --- stage 3: commit — match+pin, plan the radix insert, allocate.
+        cohort: list[GenerateRequest] = []
+        prompts: list[list[int]] = []  # SUFFIX ids (whole prompt when P == 0)
+        budgets: list[int] = []
+        slots: list[int] = []  # stacked-DFA slot per cohort member (hetero)
+        prefixes: list[tuple[int, list[int], tuple]] = []  # (P, pages, nodes)
+        sids: list[tuple] = []
+        row_pages: list[list[int]] = []
+        pushback: list[GenerateRequest] = []
+        for k, (r, slot) in enumerate(cands):
+            if pushback:
+                pushback.append(r)
+                continue
+            P, budget, ids = planned[k]
+            mnode: Optional[PrefixNode] = None
+            mpages: list[int] = []
+            if P > 0:
+                # record=False: hit/miss accounting happens AFTER the
+                # degrade decision below — a match the row cannot use
+                # (tree shrank, geometry infeasible) must not inflate the
+                # reuse counters bench phase 8 gates on.
+                P2, mpages, mnode = cache.match(
+                    r.prompt_ids,
+                    min(capacity - T, cache.match_cap(len(r.prompt_ids))),
+                    record=False,
+                )
+                if P2 != P:
+                    # The tree changed between plan and commit (an earlier
+                    # cohort-mate's insert evicted a planned node under
+                    # budget pressure): recompute this row's geometry at
+                    # the depth actually matched — P only ever SHRINKS
+                    # here. The regrown suffix is clamped to the fix-point
+                    # T below, so other rows' P + T <= capacity invariant
+                    # survives (their pad positions index the page table
+                    # at (P + t)//page_size for t < T).
+                    P = P2 if P2 and min(
+                        slab.steps, capacity - 1 - slack - P2
+                    ) >= 1 else 0
+                    if P == 0:
+                        mpages, mnode = [], None
+                    budget, ids = _geometry(r, P)
+                    ids = ids[:T]
+            if mnode is not None:
+                mnode.refs += 1
+            # Insert the page-aligned remainder of the prompt into the
+            # tree: the NEXT request sharing this head re-prefills none of
+            # it. Collision (a pending cohort-mate's branch, divergence
+            # inside the first page) or budget pressure skips caching —
+            # never the admission.
+            ins = 0
+            inode: Optional[PrefixNode] = None
+            if use_prefix:
+                want = ((P + len(ids)) // psz) * psz - P
+                if want > 0:
+                    inode = cache.insert(r.prompt_ids, P, want)
+                    if inode is not None:
+                        ins = want
+            need = len(ids) - ins + budget + slack
             if not self._allocator.can_allocate(need):
                 self._evict_prefixes(need)
                 if not self._allocator.can_allocate(need):
-                    pending.appendleft(r)  # FIFO: wait for pages, don't reorder
-                    break
+                    # FIFO: wait for pages; unwind this row's tree state and
+                    # push it (and everything after it) back unreordered.
+                    if inode is not None:
+                        cache.rollback(inode)
+                    if mnode is not None:
+                        mnode.refs -= 1
+                    pushback.append(r)
+                    continue
+            self._seq_counter += 1
+            sid = ("seq", self._seq_counter)
+            pages = self._allocator.allocate(sid, need)
+            # Hit/miss accounting only for rows that actually ADMIT (the
+            # counters are per admitted request; a pushed-back row would
+            # otherwise count twice across its two admissions).
+            if use_prefix:
+                if P > 0:
+                    cache.hits += 1
+                    cache.matched_tokens += P
+                else:
+                    cache.misses += 1
             cohort.append(r)
             prompts.append(ids)
             budgets.append(budget)
             slots.append(slot)
+            nodes = tuple(n for n in (mnode, inode) if n is not None)
+            prefixes.append((P, mpages + (inode.pages if inode else []), nodes))
+            sids.append(sid)
+            row_pages.append(pages)
+        for r in reversed(pushback):
+            pending.appendleft(r)
         for r in reversed(defer):
             pending.appendleft(r)
         if not cohort:
             return
-
-        n_pp = P // ecfg.kv_page_size
         A = _bucket(len(cohort), self._batch_buckets)
-        T = _bucket(max(len(p) for p in prompts), eligible)
+        # The STAGE-2 fix-point T, not a recompute from the committed
+        # prompts: every planned match depth satisfies P + T <= capacity
+        # against THIS T, and a commit-time degraded row's regrown suffix
+        # was clamped to it — recomputing from prompts could grow T past
+        # another deep-prefix row's invariant.
         tokens = np.full((A, T), tok.pad_id, np.int32)
         seq_lens = np.ones((A,), np.int32)
+        positions = np.zeros((A,), np.int32)  # per-row suffix start offsets
         active = np.zeros((A,), bool)
         budgets_np = np.zeros((A,), np.int32)
         # Per-row sampling config scattered at merge: the head request's
@@ -2985,7 +3295,7 @@ class InferenceEngine:
         cons_np = np.zeros((A,), bool)
         dfa_np = np.zeros((A,), np.int32)
         table = np.zeros((A, ecfg.max_pages_per_seq), np.int32)
-        sids: list[tuple] = []
+        any_prefix = False
         for j, (r, ids, budget) in enumerate(zip(cohort, prompts, budgets)):
             ids = ids[:T]
             tokens[j, : len(ids)] = ids
@@ -2999,13 +3309,19 @@ class InferenceEngine:
             else:
                 temp_np[j] = slab.temperature
                 cons_np[j] = slab.constrained
-            self._seq_counter += 1
-            sid = ("seq", self._seq_counter)
-            pages = self._allocator.allocate(sid, len(ids) + budget + slack)
-            if prefix is not None:
-                table[j, :n_pp] = prefix.pages
-            table[j, n_pp : n_pp + len(pages)] = pages
-            sids.append(sid)
+            # Page-table layout: [matched tree pages][this row's inserted
+            # tree pages][row-private pages] — positions < P read the
+            # shared, read-only tree run; the suffix prefill writes
+            # [P, P+len(ids)) into the inserted+private pages; decode
+            # writes land strictly past the prompt, in private pages.
+            P, shared_pages, _nodes = prefixes[j]
+            positions[j] = P
+            any_prefix = any_prefix or P > 0
+            n_pp = P // psz
+            n_sh = len(shared_pages)
+            table[j, :n_pp] = shared_pages[:n_pp]
+            table[j, n_pp:n_sh] = shared_pages[n_pp:]
+            table[j, n_sh : n_sh + len(row_pages[j])] = row_pages[j]
         self.metrics.kv_page_utilization.set(self._allocator.stats().utilization)
 
         try:
@@ -3016,14 +3332,14 @@ class InferenceEngine:
             # (budgets/active/sampling-config ride along for the admit call
             # and the admit-merge below).
             rs, rs2 = self._row_spec(A), self._row_spec(A, 1)
-            if prefix is not None:
+            if any_prefix:
                 (
                     tokens_d, lens_d, p_d, table_d, budgets_d, active_d,
                     temp_d, cons_d, dfa_d,
                 ) = self._put_many(
                     (tokens, rs2),
                     (seq_lens, rs),
-                    (np.full((A,), P, np.int32), rs),
+                    (positions, rs),
                     (table, rs2),
                     (budgets_np, rs),
                     (active, rs),
@@ -3032,9 +3348,12 @@ class InferenceEngine:
                     (dfa_np, rs),
                 )
                 # Suffix-only prefill: one chunked forward whose queries
-                # start at position P and attend the shared prefix pages +
-                # themselves (decode_chunk_paged's contract) — the prefix's
-                # FLOPs are paid once per cache entry, not per request.
+                # start at each row's OWN matched offset (``positions`` is
+                # per-row data — ragged rows share one executable) and
+                # attend the shared radix-tree pages + themselves
+                # (decode_chunk_paged's contract) — a matched prefix's
+                # FLOPs are paid once per resident tree path, not per
+                # request.
                 last_logits, k_p, v_p = self._jit_suffix_prefill(
                     self._params,
                     tokens_d,
@@ -3076,6 +3395,10 @@ class InferenceEngine:
             # Pools were donated to prefill: point at the live buffers
             # immediately so an exception below can't leave stale handles.
             self._paged_kv = {"k": k_p, "v": v_p}
+            # The cohort prefill that writes this admission's inserted
+            # radix nodes is dispatched: seal them — later dispatches are
+            # device-ordered behind the writes, so they may now match.
+            cache.seal()
             self._seg_counter += 1
             # Device handles only — ASYNC ADMISSION: the host never waits
             # for prefill/first-sample. (The old blocking fetch here cost a
@@ -3140,7 +3463,7 @@ class InferenceEngine:
             # first-token state lives only on device (admit outputs chained
             # into the admit-merge). EOS-at-first-sample rows retire empty
             # at their first harvest (emitted=0 via the merge).
-            slab.pos[i] = P + int(seq_lens[j])
+            slab.pos[i] = int(positions[j]) + int(seq_lens[j])
             slab.done[i] = False
             slab.budgets[i] = budgets_np[j]
             slab.page_table[i, :] = table[j]
@@ -3168,9 +3491,11 @@ class InferenceEngine:
                     cls="constrained" if r.constrained else "free",
                     row=i,
                 )
-            if prefix is not None:
-                prefix.refs += 1
-                slab.prefix[i] = prefix
+            # The radix nodes this row references were pinned at stage-3
+            # commit (match +1, insert born-pinned); the row now OWNS those
+            # pins — clear_row releases them at retirement.
+            slab.prefix[i] = prefixes[j][2]
+            slab.prefix_toks[i] = prefixes[j][0]
         if hetero:
             self.metrics.resident_grammars.set(
                 sum(1 for n in self._dfa_slot_refs[1:] if n > 0)
@@ -3178,7 +3503,9 @@ class InferenceEngine:
         rows_arr = np.full((A,), slab.B, np.int32)  # B = dropped padding
         rows_arr[: len(rows_idx)] = rows_idx
         pos_arr = np.zeros((A,), np.int32)
-        pos_arr[: len(cohort)] = P + seq_lens[: len(cohort)]
+        pos_arr[: len(cohort)] = (
+            positions[: len(cohort)] + seq_lens[: len(cohort)]
+        )
         # Draft-lookup seed: the cohort's (suffix) prompt tokens padded to
         # the slab's static buffer width (keeps the admit-merge executable
         # per-A instead of per-(A, T)), plus each row's last prompt token as
@@ -3614,13 +3941,11 @@ class InferenceEngine:
         ``self._paged_kv`` pointing at already-deleted buffers, which would
         wedge every subsequent request while /healthz still says ready. All
         resident rows were failed first, so the cached KV content is
-        worthless — fresh zeroed pools restore service. Cached prefixes'
-        KV lived in the OLD pools: serving them against zeroed pools would
-        silently corrupt every later prefix-shared generation, so they are
-        dropped (and rebuilt on next use)."""
-        for pfx in self._prefix_cache.values():
-            self._allocator.free(pfx.sid)
-        self._prefix_cache.clear()
+        worthless — fresh zeroed pools restore service. The radix tree's
+        cached KV lived in the OLD pools: serving it against zeroed pools
+        would silently corrupt every later prefix-shared generation, so
+        the whole tree is dropped (and rebuilt on next use)."""
+        self._prefix_cache.drop_all()
         self._paged_kv = self._init_pools()
         self.metrics.engine_resets.inc()
 

@@ -93,6 +93,27 @@ class PageAllocator:
             pages.append(self._free.pop())
         return list(pages)
 
+    def split(self, src_id: int, dst_id: int, n_head_pages: int) -> list[int]:
+        """Move ownership of ``src_id``'s FIRST ``n_head_pages`` pages to a
+        new sequence ``dst_id``; returns them. No device work — page ids are
+        bookkeeping — which is what lets the radix prefix cache split a
+        cached KV run at a page boundary without touching HBM
+        (engine/prefix_cache.py). The moved pages keep their ids, so page
+        tables already naming them stay valid."""
+        pages = self._seq_pages.get(src_id)
+        if pages is None:
+            raise EngineError(f"unknown sequence {src_id}")
+        if dst_id in self._seq_pages:
+            raise EngineError(f"sequence {dst_id} already has pages")
+        if not 0 < n_head_pages < len(pages):
+            raise EngineError(
+                f"split of {len(pages)} pages at {n_head_pages} leaves an "
+                "empty side (both sequences must keep at least one page)"
+            )
+        self._seq_pages[dst_id] = pages[:n_head_pages]
+        self._seq_pages[src_id] = pages[n_head_pages:]
+        return list(self._seq_pages[dst_id])
+
     def free(self, seq_id: int) -> None:
         pages = self._seq_pages.pop(seq_id, None)
         if pages is None:

@@ -1,0 +1,511 @@
+"""Radix-tree prefix KV cache: cross-request reuse of prompt-head KV pages.
+
+RadixAttention-style (SGLang, PAPERS.md) sharing generalised to the paged
+TPU engine: a radix tree over token-id sequences whose nodes own runs of
+KV pages in the existing paged pool. On admission the engine matches each
+request's prompt against the tree, pins the matched run (refcount), and
+prefills only the unmatched suffix — the ``suffix_prefill`` executable
+already takes a per-row start offset, so reuse costs zero new executables.
+The page-aligned remainder of every admitted prompt is inserted back into
+the tree, so the NEXT request sharing any prompt head (fixed planner
+header, registry shortlist block, a replan extending the original prompt)
+re-prefills none of it.
+
+Design constraints this module encodes:
+
+  - **Page granularity.** KV is shareable only in whole pages: edges are
+    token runs whose length is a positive multiple of ``page_size``, and a
+    partial edge match floors to the page boundary (splitting the edge
+    there — pure bookkeeping via ``PageAllocator.split``, no HBM copies).
+    Two prompts diverging inside their first un-shared page share nothing
+    new — there is no page to share.
+  - **Read-only by position.** A node's pages hold KV for positions
+    ``[node_start, node_end)`` of every sequence referencing them; rows
+    only ever write at positions >= their full prompt length, which land
+    in row-private pages — tree pages are write-once (their inserting
+    prefill) then read-only.
+  - **Single writer.** The engine worker thread owns the tree, exactly
+    like the page allocator (SURVEY.md §5): no locks, races structurally
+    impossible. Cross-thread readers (``queue_stats``, ``GET /cache``)
+    see only GIL-atomic counter snapshots.
+  - **Pending epoch.** Nodes inserted for an admission cohort are
+    ``pending`` until that cohort's prefill has been DISPATCHED: a row in
+    the same cohort must not attend pages whose KV the same device program
+    is still computing. ``seal()`` flips the epoch; later dispatches are
+    device-ordered behind the writes.
+  - **Refcounted eviction.** Rows (and external pins — a
+    ``/plan_and_execute`` holding its plan's prefix warm across tool
+    execution) pin the deepest node they reference; eviction removes only
+    refcount-0 LEAVES, LRU-first, under pool pressure or budget — a
+    pinned run can never be reclaimed out from under a reader, and
+    interior nodes are protected by having children.
+
+The lint rule ``unbounded-cache-growth`` polices the bug class this module
+must not introduce; every insertion path here consults ``evict()``.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Any, Optional, Sequence
+
+from mcpx.engine.kv_cache import PageAllocator
+
+
+class PrefixNode:
+    """One radix edge: ``tokens`` (length a positive multiple of the page
+    size) backed by ``pages`` in the paged pool, allocated under this
+    node's own ``sid``. ``refs`` counts live pinners (resident slab rows +
+    external pins); ``stamp`` is the LRU clock; ``pending`` marks a node
+    whose prefill has not been dispatched yet."""
+
+    __slots__ = (
+        "tokens", "pages", "children", "parent", "refs", "stamp", "pending",
+        "sid",
+    )
+
+    def __init__(
+        self,
+        tokens: tuple,
+        pages: list[int],
+        parent: Optional["PrefixNode"],
+        sid: Any,
+        *,
+        pending: bool = False,
+    ) -> None:
+        self.tokens = tokens
+        self.pages = pages
+        # Children keyed by their edge's FIRST PAGE of tokens (a tuple):
+        # page-granularity sharing means two branches diverging INSIDE a
+        # page share nothing, so they must coexist as siblings -- a
+        # first-token key would collide them (vLLM-style page-content
+        # keying; first-token radix keys only work at token granularity).
+        self.children: dict[tuple, PrefixNode] = {}
+        self.parent = parent
+        self.refs = 0
+        self.stamp = 0
+        self.pending = pending
+        self.sid = sid
+
+    def __repr__(self) -> str:  # debugging/test aid only
+        return (
+            f"PrefixNode(len={len(self.tokens)}, pages={len(self.pages)}, "
+            f"refs={self.refs}, pending={self.pending}, "
+            f"children={len(self.children)})"
+        )
+
+
+class RadixPrefixCache:
+    """Worker-thread-owned radix tree over page-aligned prompt heads."""
+
+    def __init__(
+        self,
+        allocator: PageAllocator,
+        page_size: int,
+        *,
+        max_nodes: int = 512,
+        max_tokens: int = 0,
+    ) -> None:
+        self._alloc = allocator
+        self.page_size = page_size
+        self.max_nodes = max(0, max_nodes)
+        # 0 = auto: cap tree residency at half the pool, so a fully-warm
+        # tree can never starve the slab of row pages beyond what one
+        # eviction pass reclaims.
+        self.max_tokens = (
+            max_tokens
+            if max_tokens > 0
+            else (allocator.n_pages // 2) * page_size
+        )
+        self.root = PrefixNode((), [], None, None)
+        self._clock = 0
+        self._sid_counter = 0
+        # Cross-thread-readable counters (GIL-atomic ints; queue_stats /
+        # GET /cache snapshot them without touching the tree).
+        self.n_nodes = 0
+        self.resident_tokens = 0
+        self.hits = 0
+        self.misses = 0
+        self.matched_tokens = 0
+        self.inserted_tokens = 0
+        self.evictions = 0
+        # Nodes inserted since the last seal(): sealing clears exactly
+        # these instead of walking the whole (up to max_nodes) tree on
+        # every admission.
+        self._pending_nodes: list[PrefixNode] = []
+
+    def __len__(self) -> int:
+        return self.n_nodes
+
+    # ------------------------------------------------------------- helpers
+    def _aligned(self, n: int) -> int:
+        return (n // self.page_size) * self.page_size
+
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    def _new_sid(self) -> tuple:
+        self._sid_counter += 1
+        return ("pfx", self._sid_counter)
+
+    def match_cap(self, n_prompt: int) -> int:
+        """Longest usable match for an ``n_prompt``-token prompt: page
+        aligned, and at least one suffix token must remain to prefill (the
+        engine samples from the suffix's last logit)."""
+        return self._aligned(max(0, n_prompt - 1))
+
+    # ------------------------------------------------------------- descent
+    def _descend(
+        self, ids: Sequence[int], limit: int, *, mutate: bool
+    ) -> tuple[int, list[int], Optional["PrefixNode"]]:
+        """The one radix walk probe() and match() share: follow ready
+        children by first-page key, scan edge tokens, stop at ``limit``.
+        With ``mutate`` a partial edge match SPLITS at the page boundary
+        (so the returned node covers exactly the match) and the path is
+        stamped for LRU; without it the walk is read-only and the partial
+        depth is just arithmetic. Returns (depth, pages, deepest node)."""
+        depth = 0
+        node = self.root
+        pages: list[int] = []
+        psz = self.page_size
+        tick = self._tick() if mutate else 0
+        while depth + psz <= limit:
+            child = node.children.get(tuple(ids[depth : depth + psz]))
+            if child is None or child.pending:
+                break
+            el = child.tokens
+            span = min(len(el), limit - depth)
+            common = psz
+            while common < span and el[common] == ids[depth + common]:
+                common += 1
+            if common == len(el):
+                if mutate:
+                    child.stamp = tick
+                    pages.extend(child.pages)
+                depth += common
+                node = child
+                continue
+            k = self._aligned(common)
+            if k > 0 and mutate:
+                node = self._split(child, k)
+                node.stamp = tick
+                pages.extend(node.pages)
+            depth += k
+            break
+        return depth, pages, (node if node is not self.root else None)
+
+    # --------------------------------------------------------------- probe
+    def probe(self, ids: Sequence[int], cap: Optional[int] = None) -> int:
+        """Read-only matched depth (tokens) for ``ids``: the page-aligned
+        length of the longest READY path sharing a prefix with ``ids``,
+        capped to leave a suffix token. Never splits, never stamps — the
+        locality-sort key for admission ordering. An explicit ``cap``
+        replaces the leave-a-suffix default entirely (callers compose
+        their own reserve)."""
+        limit = self.match_cap(len(ids)) if cap is None else min(
+            self._aligned(cap), self._aligned(len(ids))
+        )
+        return self._descend(ids, limit, mutate=False)[0]
+
+    # --------------------------------------------------------------- match
+    def match(
+        self,
+        ids: Sequence[int],
+        cap: Optional[int] = None,
+        *,
+        record: bool = True,
+    ) -> tuple[int, list[int], Optional[PrefixNode]]:
+        """Longest ready page-aligned match for ``ids``: returns
+        ``(n_tokens, pages, deepest_node)``. A partial edge match splits
+        the edge at the matched page boundary so the returned node covers
+        exactly the match. Counts a hit (n>0) or miss and stamps the path
+        for LRU. The caller pins ``deepest_node`` (refs += 1) for as long
+        as any page table references ``pages``."""
+        limit = self.match_cap(len(ids)) if cap is None else min(
+            self._aligned(cap), self._aligned(len(ids))
+        )
+        depth, pages, node = self._descend(ids, limit, mutate=True)
+        if record:
+            if depth > 0:
+                self.hits += 1
+                self.matched_tokens += depth
+            else:
+                self.misses += 1
+        return depth, pages, node
+
+    def _split(self, child: PrefixNode, k: int) -> PrefixNode:
+        """Split ``child``'s edge at ``k`` tokens (a page boundary):
+        insert an intermediate node owning the first ``k`` tokens/pages;
+        ``child`` keeps the tail. Page ownership moves via
+        ``PageAllocator.split`` — no device work, page ids unchanged, so
+        every live page table naming them stays valid."""
+        psz = self.page_size
+        kp = k // psz
+        parent = child.parent
+        mid = PrefixNode(child.tokens[:k], [], parent, self._new_sid())
+        mid.pages = self._alloc.split(child.sid, mid.sid, kp)
+        mid.stamp = child.stamp
+        mid.children = {child.tokens[k : k + psz]: child}
+        parent.children[child.tokens[:psz]] = mid
+        child.tokens = child.tokens[k:]
+        child.pages = child.pages[kp:]
+        child.parent = mid
+        self.n_nodes += 1
+        return mid
+
+    # -------------------------------------------------------------- lookup
+    def lookup(self, ids: Sequence[int]) -> Optional[PrefixNode]:
+        """Deepest READY node whose full path is a prefix of ``ids``
+        (whole edges only — no splitting): the external-pin handle for
+        ``/plan_and_execute`` holding its plan's prompt warm. None when
+        nothing matches."""
+        depth = 0
+        node = self.root
+        psz = self.page_size
+        limit = self.match_cap(len(ids))
+        while depth + psz <= limit:
+            child = node.children.get(tuple(ids[depth : depth + psz]))
+            if child is None or child.pending:
+                break
+            el = child.tokens
+            if depth + len(el) > limit or tuple(
+                ids[depth : depth + len(el)]
+            ) != el:
+                break
+            depth += len(el)
+            node = child
+        return node if node is not self.root else None
+
+    # -------------------------------------------------------------- insert
+    def can_insert(self, ids: Sequence[int], depth: int) -> int:
+        """Tokens insertable at ``depth`` (the end of a match): the
+        page-aligned remainder of ``ids``, or 0 when a sibling edge
+        collides (an IDENTICAL first page: only a pending cohort-mate's
+        not-yet-readable branch — a ready identical page would have been
+        matched or split into instead)."""
+        end = self._aligned(len(ids))
+        if depth >= end:
+            return 0
+        node = self._node_at(ids, depth)
+        if node is None:
+            return 0
+        key = tuple(ids[depth : depth + self.page_size])
+        if node.children.get(key) is not None:
+            return 0
+        return end - depth
+
+    def _node_at(
+        self, ids: Sequence[int], depth: int
+    ) -> Optional[PrefixNode]:
+        """The node whose path ends exactly at ``depth`` along ``ids``
+        (pending edges included — an insert right after a match must see
+        cohort-mates' branches to refuse colliding with them)."""
+        d = 0
+        node = self.root
+        psz = self.page_size
+        while d < depth:
+            child = node.children.get(tuple(ids[d : d + psz]))
+            if child is None or d + len(child.tokens) > depth:
+                return None
+            if tuple(ids[d : d + len(child.tokens)]) != child.tokens:
+                return None
+            d += len(child.tokens)
+            node = child
+        return node
+
+    def insert(
+        self, ids: Sequence[int], depth: int, n_tokens: int
+    ) -> Optional[PrefixNode]:
+        """Attach a PENDING node covering ``ids[depth : depth+n_tokens]``
+        (page aligned), allocating its pages from the pool — the caller
+        wires ``node.pages`` into the admitting row's page table and the
+        cohort prefill writes the KV. Returns None (allocating nothing)
+        on collision, page exhaustion, or budget breach after one eviction
+        pass. The node is born pinned (refs=1) by its inserting row; call
+        ``seal()`` once the prefill is dispatched."""
+        if n_tokens <= 0 or n_tokens % self.page_size:
+            return None
+        if self.can_insert(ids, depth) < n_tokens:
+            return None
+        parent = self._node_at(ids, depth)
+        if parent is None:
+            return None
+        # Budget consult BEFORE growing (the unbounded-cache-growth rule's
+        # contract): over-budget refcount-0 subtrees go first; if the tree
+        # is still over (everything resident is pinned), skip caching —
+        # serving never blocks on the cache.
+        if (
+            self.resident_tokens + n_tokens > self.max_tokens
+            or self.n_nodes + 1 > self.max_nodes
+        ):
+            self.evict()
+        if (
+            self.resident_tokens + n_tokens > self.max_tokens
+            or self.n_nodes + 1 > self.max_nodes
+        ):
+            return None
+        if not self._alloc.can_allocate(n_tokens):
+            self.evict(n_tokens)
+            if not self._alloc.can_allocate(n_tokens):
+                return None
+        sid = self._new_sid()
+        pages = self._alloc.allocate(sid, n_tokens)
+        node = PrefixNode(
+            tuple(ids[depth : depth + n_tokens]), pages, parent, sid,
+            pending=True,
+        )
+        node.stamp = self._tick()
+        node.refs = 1
+        parent.children[node.tokens[: self.page_size]] = node
+        self.n_nodes += 1
+        self.resident_tokens += n_tokens
+        self.inserted_tokens += n_tokens
+        self._pending_nodes.append(node)
+        return node
+
+    def seal(self) -> None:
+        """Clear the pending flags of everything inserted since the last
+        seal: the cohort prefill that writes those nodes' KV has been
+        dispatched, so later dispatches (device ordered behind it) may
+        read them. O(inserted-this-cohort), not O(tree)."""
+        for n in self._pending_nodes:
+            n.pending = False
+        self._pending_nodes.clear()
+
+    # ------------------------------------------------------------ eviction
+    def evict(self, need_tokens: int = 0) -> int:
+        """Reclaim refcount-0 leaf subtrees, LRU-first, until the tree is
+        within its node/token budgets and (when ``need_tokens`` is given)
+        the allocator can satisfy it. Returns tokens freed. ONE tree walk
+        gathers the evictable leaves into a stamp-ordered heap; a freed
+        leaf that exposes its parent pushes it as the next candidate — so
+        a k-leaf pressure cascade costs O(n + k log n), not k full
+        rescans (the engine worker calls this on its admission hot path
+        whenever the warm tree sits at budget)."""
+
+        def over() -> bool:
+            return (
+                self.n_nodes > self.max_nodes
+                or self.resident_tokens > self.max_tokens
+                or (need_tokens > 0 and not self._alloc.can_allocate(need_tokens))
+            )
+
+        if not over():
+            return 0
+        heap: list[tuple[int, int, PrefixNode]] = []
+        seq = 0
+        stack = [self.root]
+        while stack:
+            n = stack.pop()
+            for c in n.children.values():
+                if c.children:
+                    stack.append(c)
+                elif c.refs == 0 and not c.pending:
+                    seq += 1
+                    heapq.heappush(heap, (c.stamp, seq, c))
+        freed = 0
+        while heap and over():
+            _stamp, _seq, victim = heapq.heappop(heap)
+            if victim.parent is None or victim.children:
+                continue  # already dropped, or grew a child meanwhile
+            parent = victim.parent
+            self._drop(victim)
+            freed += len(victim.tokens)
+            if (
+                parent is not self.root
+                and not parent.children
+                and parent.refs == 0
+                and not parent.pending
+            ):
+                seq += 1
+                heapq.heappush(heap, (parent.stamp, seq, parent))
+        return freed
+
+    def _drop(self, node: PrefixNode) -> None:
+        self._alloc.free(node.sid)
+        node.parent.children.pop(node.tokens[: self.page_size], None)
+        node.parent = None
+        self.n_nodes -= 1
+        self.resident_tokens -= len(node.tokens)
+        self.evictions += 1
+
+    def rollback(self, node: PrefixNode) -> None:
+        """Detach a pending node whose prefill was never dispatched (an
+        admission unwound by page pressure or a dispatch failure): pages
+        back to the pool, insertion accounting reversed — not an
+        eviction."""
+        node.refs = 0
+        self._drop(node)
+        self.evictions -= 1
+        self.inserted_tokens -= len(node.tokens)
+        if node in self._pending_nodes:
+            self._pending_nodes.remove(node)
+
+    def drop_all(self) -> None:
+        """Free every node (engine pool reset / shutdown): cached KV lived
+        in the old pools and must not be served against new ones."""
+        stack = list(self.root.children.values())
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            self._alloc.free(n.sid)
+        self.root.children.clear()
+        self.n_nodes = 0
+        self.resident_tokens = 0
+        self._pending_nodes.clear()
+
+    # --------------------------------------------------------------- stats
+    def pinned_nodes(self) -> int:
+        count = 0
+        stack = [self.root]
+        while stack:
+            n = stack.pop()
+            for c in n.children.values():
+                if c.refs > 0:
+                    count += 1
+                stack.append(c)
+        return count
+
+    def stats(self) -> dict:
+        """Counter snapshot (safe to call cross-thread: plain int reads)."""
+        lookups = self.hits + self.misses
+        touched = self.matched_tokens + self.inserted_tokens
+        return {
+            "nodes": self.n_nodes,
+            "resident_tokens": self.resident_tokens,
+            "resident_pages": self.resident_tokens // self.page_size,
+            "hits": self.hits,
+            "misses": self.misses,
+            "hit_rate": self.hits / lookups if lookups else 0.0,
+            "matched_tokens": self.matched_tokens,
+            "inserted_tokens": self.inserted_tokens,
+            "token_hit_rate": self.matched_tokens / touched if touched else 0.0,
+            "evictions": self.evictions,
+        }
+
+    # ------------------------------------------------------------ checking
+    def check_invariants(self) -> None:
+        """Test hook: edge alignment, page/token consistency, child keys,
+        parent links, and the node/token counters."""
+        n_nodes = 0
+        tokens = 0
+        stack = [self.root]
+        while stack:
+            node = stack.pop()
+            for first_page, child in node.children.items():
+                assert child.tokens, "empty edge"
+                assert child.tokens[: self.page_size] == first_page, (
+                    "child key != first page"
+                )
+                assert len(child.tokens) % self.page_size == 0, "unaligned edge"
+                assert (
+                    len(child.pages) == len(child.tokens) // self.page_size
+                ), "page/token mismatch"
+                assert child.parent is node, "broken parent link"
+                assert child.refs >= 0, "negative refcount"
+                n_nodes += 1
+                tokens += len(child.tokens)
+                stack.append(child)
+        assert n_nodes == self.n_nodes, (n_nodes, self.n_nodes)
+        assert tokens == self.resident_tokens, (tokens, self.resident_tokens)

@@ -122,7 +122,7 @@ async def build_corpus(tokenizer, cfg: CorpusConfig | None = None) -> Corpus:
             continue
         coverages.append(q["coverage"])
         target_text = plan.to_steps_json()
-        prefix_ids, suffix_ids = build_prompt_ids(
+        prefix_ids, suffix_ids, _kept = build_prompt_ids(
             tokenizer, intent, shortlist, context, cfg.prompt_budget
         )
         prompt_ids = prefix_ids + suffix_ids

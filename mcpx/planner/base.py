@@ -30,6 +30,20 @@ class PlanContext:
     # snapshot one; consumers fetch it themselves). Keys the planner's
     # per-registry grammar cache.
     registry_version: Optional[int] = None
+    # EDF deadline (time.monotonic timestamp) the serving scheduler granted
+    # this request under, threaded to the engine so its prefix-locality
+    # admission sort never regroups a request whose deadline can't afford
+    # the wait (scheduler/locality.py). None = no deadline.
+    deadline_at: Optional[float] = None
+    # Warm-replan rendering order (names, as originally rendered): when set
+    # alongside ``exclude``, the LLM planner keeps these services in the
+    # prompt IN THIS ORDER — excluded ones included — and splices the
+    # exclusions into the SUFFIX as an Avoid line, so the replan prompt
+    # shares every byte of the original services block and the engine's
+    # radix prefix cache serves its KV instead of re-prefilling
+    # (docs/engine.md "Prefix KV reuse"). Exclusions still leave the
+    # grammar trie and the resolution map — only the rendering is stable.
+    replan_prior: Optional[tuple[str, ...]] = None
 
 
 @runtime_checkable

@@ -16,6 +16,7 @@ this subsystem.
 from mcpx.scheduler.admission import RequestContext, ShedError, TokenBucket
 from mcpx.scheduler.degrade import DegradeController
 from mcpx.scheduler.fairness import FairQueue
+from mcpx.scheduler.locality import locality_order
 from mcpx.scheduler.scheduler import Scheduler, Slot
 
 __all__ = [
@@ -26,4 +27,5 @@ __all__ = [
     "ShedError",
     "Slot",
     "TokenBucket",
+    "locality_order",
 ]

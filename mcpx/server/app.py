@@ -80,8 +80,8 @@ _LIMITED = {"/plan", "/execute", "/plan_and_execute"}
 # flush the ring with traces OF the observability itself — and `mcpx trace
 # dump`'s "newest trace" would be its own /traces listing.
 _UNTRACED = {
-    "/metrics", "/costs", "/traces", "/traces/{trace_id}", "/healthz",
-    "/telemetry",
+    "/metrics", "/costs", "/cache", "/traces", "/traces/{trace_id}",
+    "/healthz", "/telemetry",
 }
 
 
@@ -221,7 +221,12 @@ def build_app(cp: ControlPlane) -> web.Application:
                     )
         try:
             p, latency_ms = await cp.plan(
-                intent, degraded=slot.degraded if slot is not None else False
+                intent,
+                degraded=slot.degraded if slot is not None else False,
+                # The scheduler grant's EDF deadline rides to the engine so
+                # prefix-locality admission never regroups a request whose
+                # deadline can't afford the wait (scheduler/locality.py).
+                deadline_at=slot.ctx.deadline_at if slot is not None else None,
             )
         except PlannerError as e:
             return _json_error(422, f"planning failed: {e}")
@@ -412,6 +417,12 @@ def build_app(cp: ControlPlane) -> web.Application:
             }
         )
 
+    async def cache_handler(request: web.Request) -> web.Response:
+        """Combined cache stats (control-plane plan cache + engine radix
+        prefix KV cache): hit rates, resident pages, evictions — the
+        operator's one-call view instead of scrape-only counters."""
+        return web.json_response(cp.cache_stats())
+
     async def telemetry_handler(request: web.Request) -> web.Response:
         return web.json_response(
             {name: s.to_dict() for name, s in cp.telemetry.snapshot().items()}
@@ -516,6 +527,7 @@ def build_app(cp: ControlPlane) -> web.Application:
     app.router.add_delete("/services/{name}", delete_service)
     app.router.add_get("/metrics", metrics_handler)
     app.router.add_get("/costs", costs_handler)
+    app.router.add_get("/cache", cache_handler)
     app.router.add_get("/traces", traces_handler)
     app.router.add_get("/traces/{trace_id}", trace_get)
     app.router.add_get("/telemetry", telemetry_handler)

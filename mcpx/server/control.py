@@ -75,6 +75,10 @@ class ControlPlane:
         self.degraded_planner = HeuristicPlanner(self.config.planner)
         self._plan_cache: OrderedDict[tuple[str, int], Plan] = OrderedDict()
         self._cache_writes: set = set()  # in-flight shared-tier writes
+        # Plain-int plan-cache counters for GET /cache (the Prometheus
+        # counters stay the scrape surface; an operator endpoint should
+        # not have to parse the exposition text for a hit rate).
+        self.plan_cache_stats = {"hits": 0, "redis_hits": 0, "misses": 0}
 
     # ------------------------------------------------------------- lifecycle
     async def startup(self) -> None:
@@ -96,7 +100,12 @@ class ControlPlane:
 
     # ------------------------------------------------------------------ plan
     async def plan(
-        self, intent: str, *, use_cache: bool = True, degraded: bool = False
+        self,
+        intent: str,
+        *,
+        use_cache: bool = True,
+        degraded: bool = False,
+        deadline_at: Optional[float] = None,
     ) -> tuple[Plan, float]:
         """Plan an intent; returns (plan, latency_ms).
 
@@ -105,7 +114,10 @@ class ControlPlane:
         READS stay on — a hit returns a previously LLM-authored plan at
         heuristic cost, the best possible degraded response — but degraded
         plans are never WRITTEN to any cache tier (they would keep serving
-        heuristic plans after the ladder recovers)."""
+        heuristic plans after the ladder recovers). ``deadline_at`` (the
+        scheduler grant's EDF deadline, monotonic) rides the PlanContext to
+        the engine so prefix-locality admission never regroups a request
+        whose deadline can't afford it."""
         t0 = time.monotonic()
         with tracing.span(
             "plan", path="degraded" if degraded else "primary"
@@ -117,6 +129,7 @@ class ControlPlane:
                 cached = self._plan_cache.get(key)
                 if cached is not None:
                     self._plan_cache.move_to_end(key)
+                    self.plan_cache_stats["hits"] += 1
                     self.metrics.plan_cache.labels(result="hit").inc()
                     if sp is not None:
                         sp.set(cache="hit", origin=cached.origin)
@@ -129,11 +142,13 @@ class ControlPlane:
                 if shared is not None:
                     if local_tier:
                         self._cache_put(key, shared)
+                    self.plan_cache_stats["redis_hits"] += 1
                     self.metrics.plan_cache.labels(result="redis_hit").inc()
                     if sp is not None:
                         sp.set(cache="redis_hit", origin=shared.origin)
                     return shared, (time.monotonic() - t0) * 1e3  # mcpx: ignore[span-across-await-blocking] - latency_ms is a client response field, served with tracing off too
             if use_cache and (local_tier or self.redis_plan_cache is not None):
+                self.plan_cache_stats["misses"] += 1
                 self.metrics.plan_cache.labels(result="miss").inc()
                 if sp is not None:
                     sp.set(cache="miss")
@@ -142,7 +157,9 @@ class ControlPlane:
             if sp is not None:
                 sp.set(planner=type(planner).__name__)
             with tracing.span("plan.context"):
-                context = await self._context(intent, version=version)
+                context = await self._context(
+                    intent, version=version, deadline_at=deadline_at
+                )
             try:
                 plan = await planner.plan(intent, context)
                 self.metrics.plans.labels(
@@ -185,6 +202,9 @@ class ControlPlane:
         intent: str,
         exclude: Optional[set[str]] = None,
         version: Optional[int] = None,
+        *,
+        deadline_at: Optional[float] = None,
+        replan_prior: Optional[tuple[str, ...]] = None,
     ) -> PlanContext:
         shortlist = None
         exclude = exclude or set()
@@ -205,6 +225,8 @@ class ControlPlane:
             shortlist=shortlist,
             exclude=exclude,
             registry_version=version,
+            deadline_at=deadline_at,
+            replan_prior=replan_prior,
         )
 
     # --------------------------------------------------------------- execute
@@ -226,30 +248,60 @@ class ControlPlane:
     # ------------------------------------------------------- plan_and_execute
     async def plan_and_execute(self, intent: str, payload: dict[str, Any]) -> dict[str, Any]:
         """Plan, execute, and adaptively replan around observed failures
-        (bounded by ``telemetry.max_replans``)."""
+        (bounded by ``telemetry.max_replans``).
+
+        With the engine's radix prefix cache this is a structured program,
+        not three independent calls: the plan's prompt KV is PINNED for the
+        whole execution (tool calls take seconds — long enough for eviction
+        to reclaim an unpinned prefix under load), and a failure-triggered
+        replan renders its prompt as the ORIGINAL prompt plus a spliced-in
+        suffix (Avoid line carrying the breaker/replan exclusions, PR 5),
+        so the replan decode continues from the cached prefix at
+        incremental-decode cost instead of cold re-planning."""
         trace = ExecutionTrace()
         plan, _ = await self.plan(intent)
-        result = await self.execute(plan, payload, trace)
-        exclude: set[str] = set()
-        while result.status != "ok" and trace.replans < self.replan_policy.max_replans:
-            records = {r.name: r for r in await self.registry.list_services()}
-            decision = self.replan_policy.assess(plan, result, self.telemetry, records)
-            if not decision.should_replan:
-                break
-            exclude |= decision.exclude
-            self.metrics.replans.inc()
-            trace.replans += 1
-            context = await self._context(intent, exclude)
+        engine = getattr(self.planner, "engine", None)
+        pin = None
+        if engine is not None and plan.prompt_ids:
             try:
-                plan = await self.planner.plan(intent, context)
-            except Exception:
-                # Nothing viable left to route around; keep the last result
-                # — but say so, or a planner crash mid-replan is invisible.
-                log.exception(
-                    "replan attempt %d failed; keeping last result", trace.replans
-                )
-                break
+                pin = await engine.pin_prefix(plan.prompt_ids)
+            except Exception:  # noqa: BLE001 - pinning is an optimisation
+                log.debug("prefix pin failed; replans run unpinned", exc_info=True)
+        try:
             result = await self.execute(plan, payload, trace)
+            exclude: set[str] = set()
+            prior = tuple(plan.prompt_services or ())
+            while (
+                result.status != "ok"
+                and trace.replans < self.replan_policy.max_replans
+            ):
+                records = {r.name: r for r in await self.registry.list_services()}
+                decision = self.replan_policy.assess(
+                    plan, result, self.telemetry, records
+                )
+                if not decision.should_replan:
+                    break
+                exclude |= decision.exclude
+                self.metrics.replans.inc()
+                trace.replans += 1
+                context = await self._context(
+                    intent, exclude, replan_prior=prior or None
+                )
+                try:
+                    plan = await self.planner.plan(intent, context)
+                except Exception:
+                    # Nothing viable left to route around; keep the last
+                    # result — but say so, or a planner crash mid-replan is
+                    # invisible.
+                    log.exception(
+                        "replan attempt %d failed; keeping last result",
+                        trace.replans,
+                    )
+                    break
+                result = await self.execute(plan, payload, trace)
+        finally:
+            if pin is not None:
+                engine.unpin_prefix(pin)
         if trace.replans and result.status == "ok":
             # The repaired plan is the one worth caching — in EVERY enabled
             # tier; a stale failing plan left in Redis would keep re-warming
@@ -271,3 +323,29 @@ class ControlPlane:
             "origin": plan.origin,
             "trace": result.trace.to_dict() if result.trace else None,
         }
+
+    # ------------------------------------------------------------ cache stats
+    def cache_stats(self) -> dict[str, Any]:
+        """Combined cache observability for ``GET /cache``: the plan cache
+        (local LRU tier) and the engine's radix prefix KV cache — hit
+        rates, residency and evictions in one JSON read instead of
+        scrape-only Prometheus counters."""
+        s = self.plan_cache_stats
+        lookups = s["hits"] + s["redis_hits"] + s["misses"]
+        out: dict[str, Any] = {
+            "plan_cache": {
+                "entries": len(self._plan_cache),
+                "capacity": self.config.planner.plan_cache_size,
+                "redis_tier": self.redis_plan_cache is not None,
+                **s,
+                "hit_rate": (
+                    (s["hits"] + s["redis_hits"]) / lookups if lookups else 0.0
+                ),
+            },
+            "prefix_cache": None,
+        }
+        engine = getattr(self.planner, "engine", None)
+        stats_fn = getattr(engine, "prefix_cache_stats", None)
+        if stats_fn is not None:
+            out["prefix_cache"] = stats_fn()
+        return out

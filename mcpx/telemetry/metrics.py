@@ -170,14 +170,39 @@ class Metrics:
             "Full prefills routed through sequence-parallel ring attention",
             registry=self.registry,
         )
+        # Radix-tree prefix KV cache (mcpx/engine/prefix_cache.py,
+        # docs/engine.md "Prefix KV reuse"): cross-request prompt-head
+        # sharing over the paged pool.
         self.prefix_hits = Counter(
-            "mcpx_engine_prefix_cache_hits_total",
-            "Admissions served from a cached shared-prefix KV entry",
+            "mcpx_kv_prefix_hits_total",
+            "Admitted requests whose prompt matched a resident radix-tree "
+            "KV run (the suffix-only prefill path)",
             registry=self.registry,
         )
         self.prefix_misses = Counter(
-            "mcpx_engine_prefix_cache_misses_total",
-            "Shared-prefix KV entries built",
+            "mcpx_kv_prefix_misses_total",
+            "Admitted requests whose prompt matched nothing resident "
+            "(full prefill; the page-aligned prompt is inserted so the "
+            "next sharer hits)",
+            registry=self.registry,
+        )
+        self.prefix_matched_tokens = Counter(
+            "mcpx_kv_prefix_matched_tokens_total",
+            "Prompt tokens served from resident radix-tree KV instead of "
+            "being re-prefilled — with mcpx_engine_prefill_tokens_total "
+            "this is the token-level reuse rate",
+            registry=self.registry,
+        )
+        self.prefix_shared_pages = Gauge(
+            "mcpx_kv_prefix_shared_pages",
+            "KV pages resident in the radix prefix tree (shareable prompt-"
+            "head KV; competes with row pages under the eviction budget)",
+            registry=self.registry,
+        )
+        self.prefix_evictions = Counter(
+            "mcpx_kv_prefix_evictions_total",
+            "Radix-tree nodes reclaimed (refcount-0 LRU leaves dropped "
+            "under pool pressure or cache budget)",
             registry=self.registry,
         )
         # Grammar-aware speculative decoding (engine/speculative.py): how

@@ -1,0 +1,38 @@
+"""Negative fixture: bounded cache writes (eviction/size consult in
+scope), delegated bounded helpers, and non-cache containers."""
+
+
+async def handle_lru(self, request):
+    key = request["key"]
+    self._result_cache[key] = await self.compute(key)
+    while len(self._result_cache) > 64:
+        self._result_cache.popitem(last=False)  # bounded: LRU eviction
+    return self._result_cache[key]
+
+
+async def handle_evict(self, request):
+    self._page_cache[request["k"]] = await self.build(request)
+    self._evict_pages(16)  # bounded: an eviction helper is consulted
+
+
+async def handle_del(self, request):
+    self._memo[request["k"]] = 1
+    if len(self._memo) > 8:
+        del self._memo[next(iter(self._memo))]
+
+
+async def fixed_slot_counters(self, request):
+    # Literal keys are fixed slots — a stats dict, not per-request growth.
+    self.stats_cache["hits"] += 1
+    self.stats_cache["last_status"] = await self.status(request)
+
+
+async def not_a_cache(self, request):
+    results = {}
+    results[request["k"]] = await self.compute(request)  # plain dict, silent
+    return results
+
+
+def sync_insert(self, key, value):
+    # Sync helper (worker-thread / init-time code): out of scope.
+    self._result_cache[key] = value

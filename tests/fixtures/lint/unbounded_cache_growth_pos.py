@@ -1,0 +1,17 @@
+"""Positive fixture: cache insertions in request-path async functions
+with no eviction or size-bound consult in scope."""
+
+
+async def handle(self, request):
+    key = request["key"]
+    self._result_cache[key] = await self.compute(key)  # line 7: flagged
+    return self._result_cache[key]
+
+
+async def track(seen_cache, item):
+    seen_cache.append(item)  # line 12: flagged (list cache, no bound)
+    return len(item)
+
+
+async def remember(self, request):
+    self._memo.setdefault(request["k"], await self.build(request))  # flagged

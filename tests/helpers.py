@@ -56,6 +56,19 @@ def make_transport(*services: FakeService, latencies: dict[str, float] | None = 
     return transport
 
 
+def release_prefix_cache(eng) -> None:
+    """Drop the engine's radix prefix KV cache (engine/prefix_cache.py) so
+    allocator-empty assertions see only ROW leaks, not intentionally
+    cached prompt-head KV. Quiesced engines only — the worker thread owns
+    the tree; these tests poke engine internals between requests exactly
+    like the page-leak checks always have. Unpinned nodes are evicted;
+    a node still pinned by a leaked row survives and fails the caller's
+    ``sequences == 0`` assert, which is the point."""
+    eng.config.engine.prefix_cache_entries = 0
+    eng._evict_prefixes()
+    eng._prefix_cache.check_invariants()
+
+
 @contextlib.contextmanager
 def count_compiles(substring: str):
     """Count XLA compiles of executables whose ``jax_log_compiles`` message

@@ -76,6 +76,7 @@ def _stats(**overrides):
         "overload": None,
         "mixed": None,
         "spec": None,
+        "prefix": None,
         "latency_attribution": None,
         "chaos": None,
         "grammar_fallback": {"shape_only": 0, "keys_free": 0, "typed_off": 0},
@@ -93,6 +94,9 @@ def test_output_schema_carries_roofline_pallas_reason_and_verdict():
     for key in (
         "metric", "value", "p50_ms", "llm_share", "mfu", "mfu_basis",
         "pallas", "spec_speedup", "chaos_success_rate", "grammar_fallback",
+        # ISSUE 8: the prefix-reuse phase block and its promoted keys.
+        "prefix", "prefill_tokens_per_request", "prefill_reduction",
+        "prefix_hit_rate", "replan_p50_cold_ms", "replan_p50_warm_ms",
     ):
         assert key in out, key
     # ISSUE 7 fields: the roofline block…

@@ -5,6 +5,8 @@ import asyncio
 
 import pytest
 
+from tests.helpers import release_prefix_cache
+
 from mcpx.core.config import MCPXConfig
 from mcpx.core.errors import EngineError
 from mcpx.engine.engine import InferenceEngine
@@ -60,7 +62,10 @@ def test_concurrent_requests_batch_and_allocator_clean():
             assert len(results) == 6
             for r in results:
                 assert eng.grammar.walk(r.text) != eng.grammar.dead_state
-            # All pages returned after batches complete.
+            # All pages returned after batches complete (the radix prefix
+            # cache intentionally retains prompt-head KV; drop it so the
+            # check sees only row leaks).
+            release_prefix_cache(eng)
             stats = eng._allocator.stats()
             assert stats.sequences == 0
             assert stats.free_pages == stats.total_pages - 1
@@ -273,6 +278,7 @@ def test_continuous_admission_mid_stream():
             r1, r2 = await asyncio.gather(t1, t2)
             assert r1.text == solo1.text
             assert r2.text == solo2.text
+            release_prefix_cache(eng)
             stats = eng._allocator.stats()
             assert stats.sequences == 0
             eng._allocator.check_invariants()
@@ -307,6 +313,7 @@ def test_pipeline_depths_agree():
                 )
                 await asyncio.sleep(0.03 * (i % 2))
             results = await asyncio.gather(*tasks)
+            release_prefix_cache(eng)
             stats = eng._allocator.stats()
             assert stats.sequences == 0
             eng._allocator.check_invariants()
@@ -390,9 +397,10 @@ def test_engine_multichip_matches_single_chip():
 
 
 def test_shared_prefix_matches_full_prefill():
-    """Shared-prefix serving is exact: with a common prompt head cached in
-    read-only pages and only suffixes prefilled, greedy outputs are byte-
-    identical to full per-request prefill — and the prefix pages are
+    """Radix prefix serving is exact: with the declared prompt head (and
+    every admitted prompt's page-aligned remainder) cached in read-only
+    tree pages and only unmatched suffixes prefilled, greedy outputs are
+    byte-identical to full per-request prefill — and the tree is
     refcounted/evictable, never leaked."""
 
     async def go():
@@ -421,19 +429,35 @@ def test_shared_prefix_matches_full_prefill():
             )
             for f, s in zip(full, shared):
                 assert s.text == f.text, (s.text, f.text)
-            # Exactly one prefix entry was built and is now unreferenced.
-            assert len(eng_pfx._prefix_cache) == 1
-            (pfx,) = eng_pfx._prefix_cache.values()
-            assert pfx.refs == 0
-            assert pfx.n_tokens % eng_pfx.config.engine.kv_page_size == 0
-            # Allocator: only the prefix's pages remain held.
-            stats = eng_pfx._allocator.stats()
-            assert stats.sequences == 1
+            # REPEATS now match their whole page-aligned prompt (not just
+            # the declared header) and still decode identically.
+            again = await asyncio.gather(
+                *(
+                    eng_pfx.generate(
+                        p, max_new_tokens=32, shared_prefix_len=len(prefix_ids)
+                    )
+                    for p in prompts[:2]
+                )
+            )
+            for f, s in zip(full[:2], again):
+                assert s.text == f.text, (s.text, f.text)
+            cache = eng_pfx._prefix_cache
+            cache.check_invariants()
+            st = cache.stats()
+            # The shared header is one resident path plus a branch per
+            # distinct prompt tail; everything unreferenced after retire.
+            assert st["nodes"] >= 2
+            assert st["resident_tokens"] % eng_pfx.config.engine.kv_page_size == 0
+            assert cache.pinned_nodes() == 0
+            # The repeat round hit the tree (token-level reuse observable).
+            assert st["matched_tokens"] > 0 and st["hits"] >= 2
+            assert eng_pfx.metrics.prefix_hits._value.get() >= 2
+            # Allocator holds exactly the tree's pages beyond the rows.
+            assert eng_pfx._allocator.stats().sequences == st["nodes"]
             eng_pfx._allocator.check_invariants()
-            # Eviction drops it once unreferenced and over budget.
-            eng_pfx.config.engine.prefix_cache_entries = 0
-            eng_pfx._evict_prefixes()
-            assert len(eng_pfx._prefix_cache) == 0
+            # Eviction drops everything once unreferenced and over budget.
+            release_prefix_cache(eng_pfx)
+            assert len(cache) == 0
             assert eng_pfx._allocator.stats().sequences == 0
         finally:
             await eng_full.aclose()
@@ -471,8 +495,11 @@ def test_cancelled_request_reaps_row_and_pages():
             # a compile, not just a decode step.
             for _ in range(1200):
                 await asyncio.sleep(0.05)
-                if eng._allocator.stats().sequences == 0:
+                # Cached prompt-head KV legitimately stays resident; only
+                # the reaped ROW's pages must return.
+                if eng._allocator.stats().sequences == len(eng._prefix_cache):
                     break
+            release_prefix_cache(eng)
             assert eng._allocator.stats().sequences == 0
             assert eng.metrics.reaped_rows._value.get() == 1
             eng._allocator.check_invariants()
@@ -571,6 +598,7 @@ def test_cancelled_queued_request_never_admitted():
             # The abandoned request was never admitted: only the two
             # occupants were ever given rows, and nothing leaked.
             assert eng.metrics.admitted_rows._value.get() == 2
+            release_prefix_cache(eng)
             assert eng._allocator.stats().sequences == 0
             eng._allocator.check_invariants()
         finally:
@@ -700,6 +728,7 @@ def test_draft_speculation_concurrent_rows_allocator_clean():
             )
             for r in results:
                 assert eng.grammar.walk(r.text) != eng.grammar.dead_state
+            release_prefix_cache(eng)
             stats = eng._allocator.stats()
             assert stats.sequences == 0
             eng._allocator.check_invariants()
@@ -757,6 +786,7 @@ def test_hetero_mixed_slab_matches_homogeneous():
             # Stochastic constrained row: still a legal plan prefix.
             assert eng.grammar.walk(mixed[3].text) != eng.grammar.dead_state
             assert mixed[4].generated_tokens <= 12
+            release_prefix_cache(eng)
             stats = eng._allocator.stats()
             assert stats.sequences == 0
             eng._allocator.check_invariants()
@@ -827,6 +857,7 @@ def test_hetero_grammar_slots_recycle_and_defer():
             assert '"s":"aaa-svc"' in r1.text
             assert '"s":"bbb-svc"' in r2.text
             assert eng.queue_stats()["resident_grammars"] == 0
+            release_prefix_cache(eng)
             assert eng._allocator.stats().sequences == 0
             eng._allocator.check_invariants()
         finally:

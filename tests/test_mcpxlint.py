@@ -32,6 +32,7 @@ RULE_IDS = {
     "blank-lines",
     "unbounded-retry-loop",
     "metric-label-churn",
+    "unbounded-cache-growth",
 }
 
 
@@ -119,6 +120,20 @@ def test_metric_label_churn_negative():
     # Init-time construction, bounded Name/literal/conditional labels, and
     # collections.Counter stay silent.
     assert hits("metric_label_churn_neg.py", "metric-label-churn") == []
+
+
+def test_unbounded_cache_growth_positive():
+    # Subscript insert, list append, and setdefault on cache-named
+    # containers inside async request-path functions, no bound in scope.
+    assert hits(
+        "unbounded_cache_growth_pos.py", "unbounded-cache-growth"
+    ) == [7, 12, 17]
+
+
+def test_unbounded_cache_growth_negative():
+    # LRU popitem loops, eviction-helper consults, del-under-len, literal
+    # key counters, non-cache names and sync helpers all stay silent.
+    assert hits("unbounded_cache_growth_neg.py", "unbounded-cache-growth") == []
 
 
 def test_committed_baseline_is_empty():

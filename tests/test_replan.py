@@ -92,3 +92,66 @@ def test_plan_cache_hits():
         assert p3 is not p1
 
     asyncio.run(go())
+
+
+def test_plan_and_execute_pins_prefix_across_execution():
+    """The structured-program contract (ISSUE 8): plan_and_execute pins the
+    plan's prompt KV before executing, replans carry the original render
+    order (replan_prior) so the replan prompt extends the cached prefix,
+    and the pin is released exactly once when execution finishes — success
+    or failure."""
+    broken = FakeService("rank-broken", always_fail=True)
+    healthy = FakeService("rank-healthy", result={"score": "0.9"})
+
+    class PinRecorder:
+        def __init__(self):
+            self.pins = []
+            self.unpins = []
+
+        async def pin_prefix(self, ids):
+            self.pins.append(list(ids))
+            return ("pin", len(self.pins))
+
+        def unpin_prefix(self, handle):
+            self.unpins.append(handle)
+
+    async def go():
+        cfg = MCPXConfig.from_dict(
+            {
+                "planner": {"kind": "heuristic", "shortlist_top_k": 1},
+                "orchestrator": {"retry_backoff_s": 0.0, "default_retries": 0},
+                "telemetry": {"max_replans": 2},
+            }
+        )
+        transport = RouterTransport(local=make_transport(broken, healthy))
+        cp = build_control_plane(cfg, transport=transport)
+        await cp.registry.put(
+            svc_record("rank-broken", "rank items by score quality", ["query"], ["score"])
+        )
+        await cp.registry.put(
+            svc_record("rank-healthy", "rank items by score quality", ["query"], ["score"])
+        )
+        rec = PinRecorder()
+        cp.planner.engine = rec  # heuristic planner: engine slot is free
+        seen_prior = []
+        real_plan = cp.planner.plan
+
+        async def spy_plan(intent, context):
+            seen_prior.append(context.replan_prior)
+            plan = await real_plan(intent, context)
+            # Simulate LLM provenance so the pin path engages.
+            plan.prompt_ids = [1, 2, 3, 4]
+            plan.prompt_services = [n.service for n in plan.nodes]
+            return plan
+
+        cp.planner.plan = spy_plan
+        out = await cp.plan_and_execute("rank items by score quality", {"query": "q"})
+        assert out["status"] == "ok" and out["replans"] == 1
+        # Pinned once (the original plan), released exactly once.
+        assert rec.pins == [[1, 2, 3, 4]]
+        assert rec.unpins == [("pin", 1)]
+        # The replan context carried the original render order.
+        assert seen_prior[0] is None
+        assert seen_prior[1] == ("rank-broken",)
+
+    asyncio.run(go())

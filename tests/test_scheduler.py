@@ -337,6 +337,12 @@ def test_engine_queue_stats_surface():
     eng = InferenceEngine(cfg)
     st = eng.queue_stats()
     assert st == {
+        # Radix prefix-cache scoreboard (prefix-locality admission): empty
+        # tree, no lookups yet.
+        "prefix_nodes": 0,
+        "prefix_resident_pages": 0,
+        "prefix_hit_rate": 0.0,
+        "prefix_token_hit_rate": 0.0,
         "depth": 0,
         "active": 0,
         "service_ewma_s": 0.0,

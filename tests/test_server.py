@@ -152,6 +152,57 @@ def test_service_crud_and_observability():
     asyncio.run(go())
 
 
+def test_cache_endpoint_combines_plan_and_prefix_stats():
+    """GET /cache (ISSUE 8 satellite): plan-cache hit accounting readable
+    as JSON instead of scrape-only counters; the prefix block is null on a
+    heuristic control plane (no engine) and reports enabled/nodes/hit_rate
+    when an engine is attached."""
+
+    async def go():
+        cp, app = make_app()
+
+        async def drive(client):
+            await client.post(
+                "/services",
+                json={
+                    "name": "svc-a",
+                    "endpoint": "local://svc-a",
+                    "input_schema": {"x": "str"},
+                    "output_schema": {"y": "str"},
+                },
+            )
+            r = await client.post("/plan", json={"intent": "use svc-a"})
+            assert r.status == 200
+            r = await client.post("/plan", json={"intent": "use svc-a"})
+            assert r.status == 200
+            r = await client.get("/cache")
+            assert r.status == 200
+            body = await r.json()
+            pc = body["plan_cache"]
+            assert pc["hits"] == 1 and pc["misses"] == 1
+            assert pc["entries"] == 1 and pc["hit_rate"] == 0.5
+            # Heuristic planner: no engine, no prefix tree.
+            assert body["prefix_cache"] is None
+
+        await with_client(app, drive)
+
+        # With an engine-shaped planner the prefix block surfaces.
+        class EngineStub:
+            def prefix_cache_stats(self):
+                return {"enabled": True, "nodes": 3, "hit_rate": 0.75}
+
+        class PlannerStub:
+            engine = EngineStub()
+
+            async def plan(self, intent, context):
+                raise AssertionError("unused")
+
+        cp.planner = PlannerStub()
+        assert cp.cache_stats()["prefix_cache"]["nodes"] == 3
+
+    asyncio.run(go())
+
+
 def test_missing_registration_returns_400():
     async def go():
         cp, app = make_app()

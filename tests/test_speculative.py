@@ -15,6 +15,8 @@ verification in the heterogeneous slab. The invariants pinned here:
 
 import asyncio
 
+from tests.helpers import release_prefix_cache
+
 from mcpx.core.config import MCPXConfig
 from mcpx.engine.engine import InferenceEngine
 from mcpx.planner.grammar import build_plan_grammar
@@ -222,6 +224,7 @@ def test_spec_constrained_rows_never_emit_inadmissible():
                     assert state != g.dead_state, (seed, r.text)
             drafted, _ = _spec_counters(eng)
             assert drafted > 0
+            release_prefix_cache(eng)
             assert eng._allocator.stats().sequences == 0
             eng._allocator.check_invariants()
         finally:
@@ -293,6 +296,7 @@ def test_spec_slot_recycle_with_mixed_accepted_lengths():
             assert eng.grammar.walk(r4.text) != eng.grammar.dead_state
             assert eng.queue_stats()["resident_grammars"] == 0
             assert all(n == 0 for n in eng._dfa_slot_refs)
+            release_prefix_cache(eng)
             assert eng._allocator.stats().sequences == 0
             eng._allocator.check_invariants()
         finally:
