@@ -1,0 +1,30 @@
+"""Dependency-free AST primitives shared by the analysis core (call graph,
+dataflow, project index) and the rule modules. Lives outside the
+``rules`` package so the interprocedural core can import it without
+triggering ``rules/__init__``'s rule registration (which imports the core
+right back)."""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+# Spellings under which jax.jit / pjit appear in this codebase.
+JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit", "jax.experimental.pjit.pjit"}
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains (``self.x`` -> "self.x"); None
+    for anything rooted elsewhere (calls, subscripts, literals)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    return dotted_name(call.func)

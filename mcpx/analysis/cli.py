@@ -1,15 +1,23 @@
 """`mcpx lint` driver: scan, diff against the committed baseline, report.
 
 Exit codes: 0 = clean (every finding suppressed or baselined, no stale
-baseline entries); 1 = new findings and/or stale entries. ``--format json``
-emits one machine-readable object (findings + run telemetry) for CI and
-dashboards; text mode prints one ``path:line rule-id message`` per finding.
+baseline entries); 1 = new findings and/or stale entries; 2 = usage error.
+``--format json`` emits one machine-readable object (findings + run
+telemetry, per-rule wall time included) for CI and dashboards;
+``--format sarif`` emits SARIF 2.1.0 for code-scanning/editor tooling;
+text mode prints one ``path:line rule-id message`` per finding.
+
+``--changed`` scopes *reporting* to files touched in the working tree
+(``git diff HEAD`` + untracked), while the interprocedural passes still
+build their call graph over the full path set — diff-speed feedback,
+whole-program precision.
 """
 
 from __future__ import annotations
 
 import json
 import pathlib
+import subprocess
 import sys
 from typing import Iterable, Optional
 
@@ -22,6 +30,35 @@ from mcpx.analysis.baseline import (
 from mcpx.analysis.core import scan_paths
 
 
+def changed_files(root: pathlib.Path) -> list[pathlib.Path]:
+    """Working-tree ``*.py`` files that differ from HEAD (staged, unstaged
+    or untracked). Raises RuntimeError when git is unavailable.
+
+    Both listings print ``root``-relative paths: ``ls-files`` is
+    cwd-relative by default and ``diff`` needs ``--relative`` (it prints
+    repo-toplevel-relative otherwise, which joins to the wrong base
+    whenever ``root`` is a subdirectory of the repository)."""
+    out: set[pathlib.Path] = set()
+    for args in (
+        ["git", "diff", "--relative", "--name-only", "HEAD"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        try:
+            proc = subprocess.run(
+                args, cwd=root, capture_output=True, text=True, check=True,
+                timeout=30,
+            )
+        except (OSError, subprocess.SubprocessError) as e:
+            raise RuntimeError(f"cannot enumerate changed files: {e}") from e
+        for line in proc.stdout.splitlines():
+            line = line.strip()
+            if line.endswith(".py"):
+                p = root / line
+                if p.exists():
+                    out.add(p)
+    return sorted(out)
+
+
 def run_lint(
     paths: Iterable[str],
     *,
@@ -30,15 +67,47 @@ def run_lint(
     fmt: str = "text",
     rules: Optional[Iterable[str]] = None,
     root: Optional[str] = None,
+    changed: bool = False,
     out=None,
 ) -> int:
     out = out if out is not None else sys.stdout
     root_path = pathlib.Path(root) if root else pathlib.Path.cwd()
     if rules is not None:
         rules = list(rules)
+    scan_targets = [pathlib.Path(p) for p in paths]
+    project_paths = None
+    changed_set: Optional[set] = None  # report-scope relpaths under --changed
+    if changed:
+        try:
+            touched = changed_files(root_path)
+        except RuntimeError as e:
+            print(f"mcpxlint: error: {e}", file=out)
+            return 2
+        roots = [p.resolve() for p in scan_targets]
+        selected = [
+            t for t in touched
+            if any(
+                t.resolve() == r or r in t.resolve().parents for r in roots
+            )
+        ]
+        if not selected:
+            print(
+                "mcpxlint: --changed: no modified .py files under the given "
+                "paths; nothing to lint",
+                file=out,
+            )
+            return 0
+        project_paths = scan_targets  # full-tree context for the call graph
+        scan_targets = selected
+        from mcpx.analysis.core import _relpath
+
+        changed_set = {_relpath(p, root_path) for p in selected}
     try:
         result = scan_paths(
-            [pathlib.Path(p) for p in paths], root=root_path, rules=rules
+            scan_targets,
+            root=root_path,
+            rules=rules,
+            project_paths=project_paths,
         )
     except ValueError as e:  # unknown --rule id: a usage error, not a crash
         print(f"mcpxlint: error: {e}", file=out)
@@ -57,15 +126,21 @@ def run_lint(
 
     if update_baseline:
         keep: list = []
-        if rules is not None:
-            # A --rule pass only re-baselines the rules that ran; other
-            # rules' grandfathered entries pass through untouched instead of
-            # being silently wiped.
-            selected = set(rules)
+        if rules is not None or changed_set is not None:
+            # A scoped pass only re-baselines what it actually scanned: a
+            # --rule run preserves other rules' grandfathered entries, a
+            # --changed run preserves entries for files outside the diff —
+            # neither gets silently wiped.
+            selected = set(rules) if rules is not None else None
             entries = _load_entries()
             if entries is None:
                 return 2
-            keep = [e for e in entries if e["rule"] not in selected]
+            keep = [
+                e
+                for e in entries
+                if (selected is not None and e["rule"] not in selected)
+                or (changed_set is not None and e["path"] not in changed_set)
+            ]
         n = len(result.findings) + len(keep)
         save_baseline(baseline_path, result.findings, keep=keep)
         print(
@@ -83,6 +158,10 @@ def run_lint(
         # would report every other rule's grandfathered entry as stale.
         selected = set(rules)
         entries = [e for e in entries if e["rule"] in selected]
+    if changed_set is not None:
+        # And only against files that were actually scanned: a --changed
+        # run must not call an untouched file's entry stale.
+        entries = [e for e in entries if e["path"] in changed_set]
     new, baselined, stale = apply_baseline(result.findings, entries)
 
     if fmt == "json":
@@ -95,6 +174,10 @@ def run_lint(
             "exit": 1 if (new or stale) else 0,
         }
         print(json.dumps(payload, indent=2), file=out)
+    elif fmt == "sarif":
+        from mcpx.analysis.sarif import to_sarif
+
+        print(json.dumps(to_sarif(new), indent=2), file=out)
     else:
         for f in new:
             print(f.render(), file=out)

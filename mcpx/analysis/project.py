@@ -1,0 +1,205 @@
+"""ProjectContext: the whole-program view handed to project-scope rules.
+
+File-scope rules see one ``FileContext``; project-scope rules (the
+thread-ownership and jit-contract passes, the migrated cache/retry rules)
+see this object instead — every parsed file, plus lazily-built and shared
+derived structure: the symbol index, the call graph, the jit-binding
+registry and the taint engine. Building each is paid once per scan no
+matter how many rules query it.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Iterable, Optional
+
+from mcpx.analysis.callgraph import (
+    CallGraph,
+    FunctionInfo,
+    ProjectIndex,
+)
+from mcpx.analysis.astutil import JIT_NAMES, dotted_name
+
+
+@dataclasses.dataclass
+class JitSpec:
+    """One jitted executable: where it was built, the impl it traces, and
+    the arg-name contracts the jit-contract pass verifies at call sites."""
+
+    binding: str                      # last name segment calls use
+    path: str
+    line: int
+    static_argnames: frozenset
+    donate_argnames: frozenset
+    impl: Optional[FunctionInfo]      # resolved traced callable, if known
+
+    def positional_param(self, i: int) -> Optional[str]:
+        if self.impl is None:
+            return None
+        params = list(self.impl.params)
+        if self.impl.has_self and params:
+            params = params[1:]
+        return params[i] if i < len(params) else None
+
+
+def _str_names(call: ast.Call, key: str) -> frozenset:
+    for kw in call.keywords:
+        if kw.arg != key:
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            return frozenset({v.value})
+        if isinstance(v, (ast.Tuple, ast.List)):
+            return frozenset(
+                e.value
+                for e in v.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)
+            )
+    return frozenset()
+
+
+class ProjectContext:
+    def __init__(self, files: Iterable, root) -> None:
+        self.files = [f for f in files if f.tree is not None]
+        self.by_path = {f.relpath: f for f in self.files}
+        self.root = root
+        self._index: Optional[ProjectIndex] = None
+        self._graph: Optional[CallGraph] = None
+        self._taint = None
+        self._jit: Optional[dict] = None
+
+    @property
+    def index(self) -> ProjectIndex:
+        if self._index is None:
+            self._index = ProjectIndex(self.files)
+        return self._index
+
+    def callgraph(self) -> CallGraph:
+        if self._graph is None:
+            self._graph = CallGraph(self.index)
+        return self._graph
+
+    def taint(self):
+        if self._taint is None:
+            from mcpx.analysis.dataflow import TaintEngine
+
+            self._taint = TaintEngine(self.index)
+        return self._taint
+
+    def finding(self, path: str, line: int, rule_id: str, message: str):
+        from mcpx.analysis.core import Finding
+
+        return Finding(path=path, line=line, rule=rule_id, message=message)
+
+    def function_for(self, ctx, node) -> FunctionInfo:
+        """FunctionInfo for an AST function node — the indexed one when it
+        is a module-level def or method, an ephemeral one (module-scoped,
+        unique qualname) for nested defs so call/type resolution still
+        works inside them."""
+        info = self.index.fn_by_node.get(id(node))
+        if info is not None:
+            return info
+        a = node.args
+        params = tuple(p.arg for p in (*a.posonlyargs, *a.args, *a.kwonlyargs))
+        mod = ctx.module or ctx.relpath
+        return FunctionInfo(
+            qualname=f"{mod}.<local>.{node.name}@{node.lineno}",
+            module=mod,
+            name=node.name,
+            path=ctx.relpath,
+            node=node,
+            is_async=isinstance(node, ast.AsyncFunctionDef),
+            params=params,
+            has_self=bool(params) and params[0] in ("self", "cls"),
+        )
+
+    # -------------------------------------------------------- jit bindings
+    def jit_registry(self) -> dict:
+        """binding name (last segment) -> list[JitSpec]. Bindings come from
+        ``x = jax.jit(impl, ...)`` / ``self._x = wrap(..., jax.jit(impl,
+        ...), ...)`` assignments anywhere (the jit call is found inside the
+        assigned expression) and from jit-decorated defs."""
+        if self._jit is not None:
+            return self._jit
+        out: dict[str, list] = {}
+        index = self.index
+
+        def add(spec: JitSpec) -> None:
+            out.setdefault(spec.binding, []).append(spec)
+
+        def jit_call_in(expr: ast.AST) -> Optional[ast.Call]:
+            for sub in ast.walk(expr):
+                if isinstance(sub, ast.Call) and dotted_name(sub.func) in JIT_NAMES:
+                    return sub
+            return None
+
+        for info in index.functions.values():
+            env = index.local_env(info)
+            # jit-decorated def: binding is the function's own name.
+            for dec in info.node.decorator_list:
+                call = dec if isinstance(dec, ast.Call) else None
+                if call is not None and dotted_name(call.func) in JIT_NAMES:
+                    add(
+                        JitSpec(
+                            binding=info.name,
+                            path=info.path,
+                            line=info.node.lineno,
+                            static_argnames=_str_names(call, "static_argnames"),
+                            donate_argnames=_str_names(call, "donate_argnames"),
+                            impl=info,
+                        )
+                    )
+            for node in ast.walk(info.node):
+                if not isinstance(node, ast.Assign):
+                    continue
+                call = jit_call_in(node.value)
+                if call is None or not call.args:
+                    continue
+                impl = index.resolve_func_ref(call.args[0], info, env)
+                for tgt in node.targets:
+                    name = dotted_name(tgt)
+                    if name is None:
+                        continue
+                    add(
+                        JitSpec(
+                            binding=name.rsplit(".", 1)[-1],
+                            path=info.path,
+                            line=node.lineno,
+                            static_argnames=_str_names(call, "static_argnames"),
+                            donate_argnames=_str_names(call, "donate_argnames"),
+                            impl=impl,
+                        )
+                    )
+        # Module-level `step = jax.jit(_step, ...)` assignments.
+        for mod in index.modules.values():
+            mod_info = FunctionInfo(
+                qualname=mod.name + ".<module>",
+                module=mod.name,
+                name="<module>",
+                path=mod.path,
+                node=ast.parse(""),  # placeholder; env below is empty
+            )
+            for stmt in mod.tree.body:
+                if not isinstance(stmt, ast.Assign):
+                    continue
+                call = jit_call_in(stmt.value)
+                if call is None or not call.args:
+                    continue
+                impl = index.resolve_func_ref(call.args[0], mod_info, {})
+                for tgt in stmt.targets:
+                    name = dotted_name(tgt)
+                    if name is None:
+                        continue
+                    add(
+                        JitSpec(
+                            binding=name.rsplit(".", 1)[-1],
+                            path=mod.path,
+                            line=stmt.lineno,
+                            static_argnames=_str_names(call, "static_argnames"),
+                            donate_argnames=_str_names(call, "donate_argnames"),
+                            impl=impl,
+                        )
+                    )
+        self._jit = out
+        return out

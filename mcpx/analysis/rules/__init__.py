@@ -6,7 +6,9 @@ from mcpx.analysis.rules import (  # noqa: F401
     async_rules,
     cache_rules,
     jax_rules,
+    jit_contract_rules,
     metrics_rules,
+    ownership_rules,
     resilience_rules,
     style_rules,
     tracing_rules,

@@ -2,12 +2,20 @@
 
 The bug class: a dict/list used as a cache ("cache"/"memo" in its name)
 that a request-path async function INSERTS into without any eviction or
-size-bound consult in the same scope. Every request leaks an entry; the
-process grows until the OOM killer finds it — silent in tests (bounded
-request counts) and fatal in production. The radix prefix KV cache PR is
-exactly this shape done right (engine/prefix_cache.py: every insertion
-path consults ``evict()`` and a budget), and this rule keeps the next
-cache honest.
+size-bound consult reachable from the same scope. Every request leaks an
+entry; the process grows until the OOM killer finds it — silent in tests
+(bounded request counts) and fatal in production. The radix prefix KV
+cache is exactly this shape done right (engine/prefix_cache.py: every
+insertion path consults ``evict()`` and a budget), and this rule keeps
+the next cache honest.
+
+Since the interprocedural rebuild this is a **project-scope** rule: the
+bound consult no longer has to sit in the inserting function's own body.
+A call to a helper — same module or imported — that evicts/pops/``len``s
+the container (passed as an argument, or named identically on ``self``)
+counts, transitively to a small depth. That kills the rule's known
+false-positive class (bounded-insert helpers forced a suppression) while
+the insertion sites themselves are still judged per async function.
 
 What counts as an insertion (on a cache-named container):
 
@@ -15,8 +23,8 @@ What counts as an insertion (on a cache-named container):
     is exempt — ``stats_cache["hits"] += 1`` is a fixed slot, not growth)
   - ``X.append(v)`` / ``X.add(v)`` / ``X.setdefault(k, v)`` / ``X.insert(...)``
 
-What counts as a bound consult (same function scope, same container —
-or any call whose name mentions eviction):
+What counts as a bound consult (in scope, or in a resolvable callee up to
+depth 2 — on the same container / the parameter it was passed as):
 
   - ``X.pop`` / ``X.popitem`` / ``X.clear`` / ``X.evict``
   - ``del X[...]``
@@ -25,9 +33,9 @@ or any call whose name mentions eviction):
 
 Scope: async functions only — this codebase's request path is async end
 to end; sync worker-thread code (the engine) manages its caches under
-explicit budgets and single-writer discipline. Containers without a
-cache-ish name stay silent: flagging every dict write would bury the
-real leaks.
+explicit budgets and single-writer discipline (now machine-checked by
+``thread-ownership``). Containers without a cache-ish name stay silent:
+flagging every dict write would bury the real leaks.
 """
 
 from __future__ import annotations
@@ -35,11 +43,12 @@ from __future__ import annotations
 import ast
 from typing import Iterator, Optional
 
-from mcpx.analysis.core import FileContext, Finding, rule
+from mcpx.analysis.core import Finding, rule
 from mcpx.analysis.rules.common import async_functions, call_name, dotted_name, walk_scope
 
 _INSERT_METHODS = {"append", "add", "setdefault", "insert"}
 _CONSULT_METHODS = {"pop", "popitem", "clear", "evict"}
+_MAX_DEPTH = 2
 
 
 def _cache_named(name: Optional[str]) -> bool:
@@ -70,11 +79,11 @@ def _insertions(fn) -> Iterator[tuple[int, str]]:
                     yield node.lineno, name
 
 
-def _consulted(fn, container: str) -> bool:
-    """True when the function scope bounds ``container`` somewhere: an
-    eviction-ish method call, a ``del``, a ``len()`` size check, or any
+def _direct_consult(body_walk, container: str) -> bool:
+    """A bound consult on ``container`` in one function's own statements:
+    an eviction-ish method call, a ``del``, a ``len()`` size check, or any
     call whose name mentions eviction."""
-    for node in walk_scope(fn):
+    for node in body_walk:
         if isinstance(node, ast.Call):
             fname = call_name(node)
             if fname == "len" and node.args:
@@ -95,25 +104,73 @@ def _consulted(fn, container: str) -> bool:
     return False
 
 
+def _consulted(
+    fn, container: str, project, caller_info, depth: int = _MAX_DEPTH
+) -> bool:
+    """Bound consult on ``container`` in ``fn``'s scope OR inside a
+    resolvable callee: either the callee receives the container as an
+    argument and consults the matching parameter, or it is a method
+    consulting the same ``self.<attr>`` name directly."""
+    if _direct_consult(walk_scope(fn), container):
+        return True
+    if depth <= 0 or project is None:
+        return False
+    index = project.index
+    env = index.local_env(caller_info)
+    for node in walk_scope(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = index.resolve_call(node, caller_info, env)
+        if callee is None:
+            continue
+        # the container itself handed to the helper -> the helper's view
+        # of it is the matching parameter
+        params = list(callee.params)
+        if callee.has_self and params:
+            params = params[1:]
+        bound_params: list[str] = []
+        for i, a in enumerate(node.args):
+            if not isinstance(a, ast.Starred) and dotted_name(a) == container:
+                if i < len(params):
+                    bound_params.append(params[i])
+        for kw in node.keywords:
+            if kw.arg is not None and dotted_name(kw.value) == container:
+                bound_params.append(kw.arg)
+        names = list(bound_params)
+        # a same-class helper (`self._trim()`) may consult `self._cache`
+        # under its own name
+        if container.startswith("self.") and callee.cls == caller_info.cls:
+            names.append(container)
+        for name in names:
+            if _consulted(callee.node, name, project, callee, depth - 1):
+                return True
+    return False
+
+
 @rule(
     "unbounded-cache-growth",
     "Cache insertion in a request-path async function with no eviction "
-    "or size-bound consult in scope",
+    "or size-bound consult reachable in scope",
+    scope="project",
 )
-def check_unbounded_cache_growth(ctx: FileContext) -> Iterator[Finding]:
-    for fn in async_functions(ctx.tree):
-        flagged: set[tuple[int, str]] = set()
-        for lineno, container in _insertions(fn):
-            if (lineno, container) in flagged:
-                continue
-            if _consulted(fn, container):
-                continue
-            flagged.add((lineno, container))
-            yield ctx.finding(
-                lineno,
-                "unbounded-cache-growth",
-                f"'{container}' grows by one entry per call of async "
-                f"'{fn.name}' with no eviction/size-bound consult in scope "
-                "— a per-request memory leak; bound it (LRU popitem, "
-                "len() cap, evict()) or insert via a bounded helper",
-            )
+def check_unbounded_cache_growth(project) -> Iterator[Finding]:
+    for ctx in project.files:
+        for fn in async_functions(ctx.tree):
+            info = project.function_for(ctx, fn)
+            flagged: set[tuple[int, str]] = set()
+            for lineno, container in _insertions(fn):
+                if (lineno, container) in flagged:
+                    continue
+                if _consulted(fn, container, project, info):
+                    continue
+                flagged.add((lineno, container))
+                yield project.finding(
+                    ctx.relpath,
+                    lineno,
+                    "unbounded-cache-growth",
+                    f"'{container}' grows by one entry per call of async "
+                    f"'{fn.name}' with no eviction/size-bound consult in "
+                    "scope or in any resolvable helper — a per-request "
+                    "memory leak; bound it (LRU popitem, len() cap, "
+                    "evict()) or insert via a bounded helper",
+                )

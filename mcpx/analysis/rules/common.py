@@ -6,11 +6,14 @@ from __future__ import annotations
 import ast
 from typing import Iterator, Optional, Union
 
+from mcpx.analysis.astutil import (  # noqa: F401 - re-exported rule API
+    JIT_NAMES,
+    call_name,
+    dotted_name,
+)
+
 FunctionNode = Union[ast.FunctionDef, ast.AsyncFunctionDef]
 _FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
-
-# Spellings under which jax.jit / pjit appear in this codebase.
-JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit", "jax.experimental.pjit.pjit"}
 # lax control-flow combinators -> positional args that are traced callables.
 _TRACED_CALLEE_ARGS = {
     "lax.scan": (0,),
@@ -24,23 +27,6 @@ _TRACED_CALLEE_ARGS = {
     "lax.map": (0,),
     "jax.lax.map": (0,),
 }
-
-
-def dotted_name(node: ast.AST) -> Optional[str]:
-    """``a.b.c`` for Name/Attribute chains (``self.x`` -> "self.x"); None
-    for anything rooted elsewhere (calls, subscripts, literals)."""
-    parts: list[str] = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return None
-
-
-def call_name(call: ast.Call) -> Optional[str]:
-    return dotted_name(call.func)
 
 
 def walk_scope(fn: FunctionNode, *, include_nested_defs: bool = False) -> Iterator[ast.AST]:

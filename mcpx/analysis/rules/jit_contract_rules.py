@@ -1,0 +1,199 @@
+"""jit-contract: request-derived values must not reach jitted static args,
+and donated buffers must not be used after dispatch.
+
+The interprocedural generalization of ``jit-static-branch``. That rule
+sees one function; this pass follows VALUES. Two contracts, verified at
+every call site of every jitted binding in the project:
+
+  - **No request-derived static args.** A per-request value (a field of a
+    ``# mcpx: request-payload`` class — the engine's queue payload — or an
+    async handler's ``request`` param) flowing into a ``static_argnames``
+    arg compiles a NEW executable per distinct value: the retrace storm
+    PR 7's sentinel counts only after a compile has already burned
+    seconds inside the serving path. The taint engine
+    (mcpx/analysis/dataflow.py) tracks provenance across helper calls,
+    attribute stores and container hops; bucketing (``_bucket``-style
+    quantizers) launders taint because a fixed bucket grid makes the arg
+    finite by construction — exactly the sanctioned idiom.
+  - **No use-after-donation.** An argument listed in ``donate_argnames``
+    is invalidated by the dispatch; any later read of the same binding in
+    the same function, before it is reassigned, observes a deleted buffer
+    (``RuntimeError`` at best, garbage under async dispatch at worst).
+    The engine's convention — rebind the pool from the call's outputs on
+    the very next line — is the clean shape this check locks in.
+
+Jitted bindings are discovered project-wide (``x = jax.jit(f, ...)``,
+``self._x = wrap(..., jax.jit(self._impl, ...), ...)``, jit-decorated
+defs) and matched at call sites by binding name; positional args map onto
+the traced impl's signature when it resolves.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from mcpx.analysis.core import Finding, rule
+from mcpx.analysis.rules.common import dotted_name
+
+
+def _base_name(expr: ast.AST) -> Optional[str]:
+    """Dotted name of the buffer a call argument references:
+    ``self._paged_kv["k"]`` -> ``self._paged_kv``."""
+    while isinstance(expr, ast.Subscript):
+        expr = expr.value
+    return dotted_name(expr)
+
+
+def _post_path(fn_node, call: ast.Call) -> tuple:
+    """(containing_stmt, post): the innermost statement holding ``call``,
+    and the statements that execute strictly after it, innermost level
+    first — at every nesting level on the path to the call, the siblings
+    AFTER the enclosing statement. Sibling branches of the same ``if``
+    (the other arm) are not on the path and are excluded — a donation in
+    one arm is never "used" by the other."""
+
+    def descend(stmts: list) -> Optional[list]:
+        for i, s in enumerate(stmts):
+            if not any(n is call for n in ast.walk(s)):
+                continue
+            inner: Optional[list] = None
+            for field in ("body", "orelse", "finalbody"):
+                lst = getattr(s, field, None)
+                if isinstance(lst, list) and lst and inner is None:
+                    inner = descend(lst)
+            if inner is None and hasattr(s, "handlers"):
+                for h in s.handlers:
+                    inner = descend(h.body)
+                    if inner is not None:
+                        break
+            return (inner or []) + [(stmts, i)]
+        return None
+
+    path = descend(fn_node.body) or []
+    post: list = []
+    for stmts, i in path:
+        post.extend(stmts[i + 1 :])
+    containing = path[0][0][path[0][1]] if path else None
+    return containing, post
+
+
+@rule(
+    "jit-contract",
+    "request-derived value reaching a jitted static arg (per-value "
+    "recompile), or a donated buffer read after dispatch",
+    scope="project",
+)
+def check_jit_contract(project) -> Iterator[Finding]:
+    registry = project.jit_registry()
+    if not registry:
+        return
+    index = project.index
+    taint = None  # built lazily: only when a jit call site actually exists
+    for info in index.functions.values():
+        calls = []
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name is None:
+                continue
+            specs = registry.get(name.rsplit(".", 1)[-1])
+            if specs:
+                calls.append((node, specs))
+        if not calls:
+            continue
+        if taint is None:
+            taint = project.taint()
+        env_types, var = taint.function_env(info)
+        seen: set[tuple] = set()
+        for call, specs in calls:
+            for spec in specs:
+                # ---- static args fed request-derived values
+                bound: list[tuple[str, ast.AST]] = []
+                for i, a in enumerate(call.args):
+                    if isinstance(a, ast.Starred):
+                        # an unpacked argument of unknown arity shifts every
+                        # later position — stop mapping positionals here
+                        break
+                    p = spec.positional_param(i)
+                    if p is not None:
+                        bound.append((p, a))
+                for kw in call.keywords:
+                    if kw.arg is not None:
+                        bound.append((kw.arg, kw.value))
+                for pname, expr in bound:
+                    if pname not in spec.static_argnames:
+                        continue
+                    labels = taint.expr_taint(expr, info, env_types, var)
+                    if not labels:
+                        continue
+                    key = ("static", call.lineno, pname)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    origin = sorted(labels)[0]
+                    yield project.finding(
+                        info.path,
+                        call.lineno,
+                        "jit-contract",
+                        f"request-derived value ({origin}) reaches static "
+                        f"arg '{pname}' of jitted '{spec.binding}' — every "
+                        "distinct value compiles a new executable (retrace "
+                        "storm in the serving path); pass it as traced "
+                        "device data or quantize it onto a fixed bucket "
+                        "grid first",
+                    )
+                # ---- use-after-donation
+                if not spec.donate_argnames:
+                    continue
+                donated: set[str] = set()
+                for pname, expr in bound:
+                    if pname in spec.donate_argnames:
+                        b = _base_name(expr)
+                        if b is not None:
+                            donated.add(b)
+                if not donated:
+                    continue
+                containing, post = _post_path(info.node, call)
+                if isinstance(containing, ast.Assign):
+                    # `pool = consume(pool)` — the dispatch statement
+                    # itself rebinds the buffer, closing the window
+                    donated -= {
+                        dotted_name(t)
+                        for t in containing.targets
+                        if dotted_name(t) is not None
+                    }
+                for d in donated:
+                    # walk the post-dispatch statements in execution order;
+                    # the first rebind of the buffer closes the window
+                    for stmt in post:
+                        if isinstance(stmt, ast.Assign) and any(
+                            dotted_name(t) == d for t in stmt.targets
+                        ):
+                            break
+                        hit = None
+                        for node in ast.walk(stmt):
+                            if (
+                                isinstance(node, (ast.Attribute, ast.Name))
+                                and isinstance(node.ctx, ast.Load)
+                                and dotted_name(node) == d
+                            ):
+                                hit = node
+                                break
+                        if hit is None:
+                            continue
+                        key = ("donate", hit.lineno, d)
+                        if key not in seen:
+                            seen.add(key)
+                            yield project.finding(
+                                info.path,
+                                hit.lineno,
+                                "jit-contract",
+                                f"'{d}' was donated to jitted "
+                                f"'{spec.binding}' (line {call.lineno}) and "
+                                "read again before being rebound — donation "
+                                "invalidates the buffer; rebind it from the "
+                                "dispatch outputs first",
+                            )
+                        break  # one finding per donation window

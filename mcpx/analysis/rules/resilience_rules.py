@@ -10,6 +10,13 @@ already given up on. The fix is a deadline/budget consult (or an explicit
 give-up ``raise``/``break``) inside the loop — or using the executor's
 attempt chain, which carries both.
 
+Since the interprocedural rebuild this is a **project-scope** rule: a
+bound consult counts when it lives in a helper the loop calls (resolved
+through the project index, transitively to a small depth) — an
+innocuously-named ``_check_time_left()`` that raises on an expired
+deadline bounds the loop just as well as an inline ``if remaining <= 0``,
+and no longer needs a suppression.
+
 Matching is deliberately narrow: only awaits of HTTP-verb methods
 (``.post``/``.get``/``.request``/…) on transport-shaped receivers
 (``session``/``client``/``transport``/``http`` in the dotted base), so
@@ -25,7 +32,7 @@ import ast
 import re
 from typing import Iterator, Optional, Union
 
-from mcpx.analysis.core import FileContext, Finding, rule
+from mcpx.analysis.core import Finding, rule
 from mcpx.analysis.rules.common import (
     async_functions,
     call_name,
@@ -106,12 +113,12 @@ def _loop_kind(node: ast.AST) -> Optional[str]:
     return None
 
 
-def _consults_bound(loop: _LoopNode) -> bool:
-    """Any branch condition (or call) inside the loop that mentions a
-    bound-shaped identifier: ``if remaining <= 0``, ``budget.affords(…)``,
-    ``while attempts < max_attempts`` …"""
+def _mentions_bound(scope: ast.AST) -> bool:
+    """A bound-shaped identifier in any branch condition, or a call to a
+    bound-named helper, anywhere in ``scope``: ``if remaining <= 0``,
+    ``budget.affords(…)``, ``while attempts < max_attempts`` …"""
     tests: list[ast.AST] = []
-    for n in _walk_no_defs(loop):
+    for n in _walk_no_defs(scope):
         if isinstance(n, (ast.If, ast.While)):
             tests.append(n.test)
         elif isinstance(n, ast.Assert):
@@ -129,37 +136,84 @@ def _consults_bound(loop: _LoopNode) -> bool:
     return False
 
 
+def _consults_bound(loop: _LoopNode, project, caller_info, depth: int = 2) -> bool:
+    """The loop consults a bound inline, or calls a helper (resolved
+    through the project index, ``depth`` levels deep) that does — a
+    deadline check living in ``_check_time_left()`` bounds the loop just
+    as much as an inline test."""
+    if _mentions_bound(loop):
+        return True
+    if depth <= 0 or project is None:
+        return False
+    index = project.index
+    env = index.local_env(caller_info)
+    for n in _walk_no_defs(loop):
+        if not isinstance(n, ast.Call):
+            continue
+        callee = index.resolve_call(n, caller_info, env)
+        if callee is None:
+            continue
+        if _mentions_bound(callee.node):
+            return True
+        if _consults_bound_body(callee.node, project, callee, depth - 1):
+            return True
+    return False
+
+
+def _consults_bound_body(fn_node, project, info, depth: int) -> bool:
+    if depth <= 0:
+        return False
+    index = project.index
+    env = index.local_env(info)
+    for n in _walk_no_defs(fn_node):
+        if not isinstance(n, ast.Call):
+            continue
+        callee = index.resolve_call(n, info, env)
+        if callee is None:
+            continue
+        if _mentions_bound(callee.node):
+            return True
+        if _consults_bound_body(callee.node, project, callee, depth - 1):
+            return True
+    return False
+
+
 @rule(
     "unbounded-retry-loop",
     "retry loop around a transport call with no deadline or attempt bound — "
     "a persistent outage spins it forever (or through the caller's SLO)",
+    scope="project",
 )
-def check_unbounded_retry(ctx: FileContext) -> Iterator[Finding]:
-    for fn in async_functions(ctx.tree):
-        # walk_scope skips nested defs: a loop inside a nested async def is
-        # reported once, under ITS function (async_functions yields it too),
-        # never twice under every enclosing scope.
-        for node in walk_scope(fn):
-            kind = _loop_kind(node)
-            if kind is None:
-                continue
-            for n in _walk_no_defs(node):
-                if not isinstance(n, ast.Try):
+def check_unbounded_retry(project) -> Iterator[Finding]:
+    for ctx in project.files:
+        for fn in async_functions(ctx.tree):
+            info = project.function_for(ctx, fn)
+            # walk_scope skips nested defs: a loop inside a nested async
+            # def is reported once, under ITS function (async_functions
+            # yields it too), never twice under every enclosing scope.
+            for node in walk_scope(fn):
+                kind = _loop_kind(node)
+                if kind is None:
                     continue
-                try_body = ast.Module(body=n.body, type_ignores=[])
-                if not _awaits_transport(try_body):
-                    continue
-                if not any(_handler_swallows(h) for h in n.handlers):
-                    continue
-                if _consults_bound(node):
-                    continue
-                yield ctx.finding(
-                    node.lineno,
-                    "unbounded-retry-loop",
-                    f"{kind} loop in async '{fn.name}' awaits a transport "
-                    "call and swallows its failure with no deadline or "
-                    "attempt bound — consult a deadline/budget (or raise/"
-                    "break on a bound) so a persistent outage cannot spin "
-                    "this loop forever",
-                )
-                break
+                for n in _walk_no_defs(node):
+                    if not isinstance(n, ast.Try):
+                        continue
+                    try_body = ast.Module(body=n.body, type_ignores=[])
+                    if not _awaits_transport(try_body):
+                        continue
+                    if not any(_handler_swallows(h) for h in n.handlers):
+                        continue
+                    if _consults_bound(node, project, info):
+                        continue
+                    yield project.finding(
+                        ctx.relpath,
+                        node.lineno,
+                        "unbounded-retry-loop",
+                        f"{kind} loop in async '{fn.name}' awaits a transport "
+                        "call and swallows its failure with no deadline or "
+                        "attempt bound (inline or in any resolvable helper) — "
+                        "consult a deadline/budget (or raise/break on a "
+                        "bound) so a persistent outage cannot spin this "
+                        "loop forever",
+                    )
+                    break

@@ -260,6 +260,7 @@ def cmd_lint(args: argparse.Namespace) -> int:
         update_baseline=args.update_baseline,
         fmt=args.format,
         rules=args.rule or None,
+        changed=args.changed,
     )
 
 
@@ -392,12 +393,18 @@ def main(argv: list[str] | None = None) -> int:
         help="rewrite the baseline from the current findings and exit 0",
     )
     p_lint.add_argument(
-        "--format", choices=["text", "json"], default="text",
-        help="report format (json includes run telemetry for CI)",
+        "--format", choices=["text", "json", "sarif"], default="text",
+        help="report format (json includes run telemetry for CI; sarif is "
+        "SARIF 2.1.0 for code-scanning/editor tooling)",
     )
     p_lint.add_argument(
         "--rule", action="append", metavar="RULE_ID",
         help="run only this rule (repeatable; default: all)",
+    )
+    p_lint.add_argument(
+        "--changed", action="store_true",
+        help="report only files modified vs HEAD (staged/unstaged/"
+        "untracked); interprocedural passes still see the full path set",
     )
     p_lint.set_defaults(func=cmd_lint)
 

@@ -85,12 +85,13 @@ from mcpx.scheduler.locality import locality_order
 from mcpx.telemetry import tracing
 from mcpx.telemetry.costs import CostRegistry, device_peaks, rounded_roofline
 from mcpx.telemetry.metrics import Metrics
+from mcpx.utils.ownership import owned_by
 
 log = logging.getLogger("mcpx.engine")
 
 
 @dataclasses.dataclass
-class GenerateRequest:
+class GenerateRequest:  # mcpx: request-payload
     prompt_ids: list[int]
     max_new_tokens: int
     constrained: bool
@@ -172,10 +173,12 @@ def _bucket(n: int, buckets: tuple[int, ...]) -> int:
     raise EngineError(f"length {n} exceeds largest bucket {buckets[-1]}")
 
 
+@owned_by("engine-worker")
 class _Slab:
     """Host-side state of the persistent decode batch. Single writer (the
-    engine worker thread); the race-detection analogue SURVEY.md §5 asks
-    for is discharged structurally, exactly like the page allocator.
+    engine worker thread, enforced by mcpxlint's thread-ownership pass via
+    the class-level ``owned_by``); the race-detection analogue SURVEY.md §5
+    asks for is discharged structurally, exactly like the page allocator.
 
     Invariant between worker iterations: every row with a live request has
     ``done=False``; every free row has ``req=None, done=True`` and a zeroed
@@ -371,12 +374,12 @@ class InferenceEngine:
         self._thread: Optional[threading.Thread] = None
         self._started = threading.Event()
         self._stop = False
-        self._startup_error: Optional[BaseException] = None
+        self._startup_error: Optional[BaseException] = None  # mcpx: owner[engine-worker, atomic]
         # Device state (worker thread only after start):
-        self._params = None
-        self._paged_kv = None
+        self._params = None  # mcpx: owner[engine-worker]
+        self._paged_kv = None  # mcpx: owner[engine-worker]
         self._seq_mesh = None
-        self._dfa_cache: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self._dfa_cache: "OrderedDict[tuple, tuple]" = OrderedDict()  # mcpx: owner[engine-worker]
         # Heterogeneous batching (EngineConfig.hetero_batch): the stacked-DFA
         # slot table. ``_dfa_slots[k]`` is the grammar whose padded tables
         # occupy stack index k (slot 0 = trivial all-accept DFA, None = free
@@ -385,46 +388,46 @@ class InferenceEngine:
         # ``_stack_cache`` holds the stacked device tables keyed by slot
         # occupancy so re-admissions of resident grammars upload nothing.
         # Worker thread only.
-        self._trivial_grammar: Optional[PlanGrammar] = None
-        self._dfa_slots: list[Optional[PlanGrammar]] = []
-        self._dfa_slot_refs: list[int] = []
-        self._stack_cache: Optional[tuple] = None  # (key, slot grammars, tables)
+        self._trivial_grammar: Optional[PlanGrammar] = None  # mcpx: owner[engine-worker]
+        self._dfa_slots: list[Optional[PlanGrammar]] = []  # mcpx: owner[engine-worker]
+        self._dfa_slot_refs: list[int] = []  # mcpx: owner[engine-worker, atomic]
+        self._stack_cache: Optional[tuple] = None  # (key, slot grammars, tables)  # mcpx: owner[engine-worker]
         # Per-class backlog snapshot published by the worker each iteration
         # for queue_stats() (cross-thread read of a freshly-swapped dict).
-        self._pending_stats: dict = {
+        self._pending_stats: dict = {  # mcpx: owner[engine-worker, atomic]
             "constrained": 0, "free": 0, "hol_wait_ms": 0.0,
         }
         # Pipelined segment outputs awaiting their (lagged) flag fetch:
         # entries are (done, emitted, out_buf, n_fwd device handles,
         # gen snapshot); decode wall time is taken at harvest. Worker
         # thread only.
-        self._inflight: "deque[tuple]" = deque()
+        self._inflight: "deque[tuple]" = deque()  # mcpx: owner[engine-worker]
         # Rows retired on the host whose DEVICE page-table rows still point
         # at freed pages; zeroed (scatter to the null page) in the next
         # merge dispatch — which always happens before freed pages can be
         # reused, because reuse requires an admission and every admission
         # dispatches a merge.
-        self._dirty_rows: set[int] = set()
+        self._dirty_rows: set[int] = set()  # mcpx: owner[engine-worker]
         # Admission chains whose completion hasn't been observed yet:
         # (dispatch-end time, marker handle, row indices, gen snapshot).
         # Resolved by non-blocking is_ready() polls — admission never
         # blocks the host (async admission), so prefill timing comes from
         # the poll that first sees the chain finished (≤1 tick late).
-        self._pending_admissions: list[tuple] = []
-        self._seg_counter = 0
-        self._seq_counter = 0
-        self._last_admit_t = 0.0
+        self._pending_admissions: list[tuple] = []  # mcpx: owner[engine-worker]
+        self._seg_counter = 0  # mcpx: owner[engine-worker]
+        self._seq_counter = 0  # mcpx: owner[engine-worker]
+        self._last_admit_t = 0.0  # mcpx: owner[engine-worker]
         # EWMA of per-request engine service time (prefill + decode wall
         # seconds, queue wait excluded), updated at retirement. Written by
         # the worker thread, read cross-thread by queue_stats() — a single
         # float store is GIL-atomic, and the scheduler's ETA math only
         # needs an estimate, not a snapshot.
-        self._ewma_service_s = 0.0
+        self._ewma_service_s = 0.0  # mcpx: owner[engine-worker, atomic]
         # Per-process entropy so temperature>0 sampling differs across
         # restarts and DP replicas (a bare counter would replay the same
         # stream everywhere); each dispatch folds the counter in.
         self._rng_base = time.time_ns() & 0x3FFFFFFF
-        self._allocator = PageAllocator(
+        self._allocator = PageAllocator(  # mcpx: owner[engine-worker]
             n_pages=max(
                 2,
                 ecfg.max_batch_size * ecfg.max_pages_per_seq + 1,
@@ -435,7 +438,7 @@ class InferenceEngine:
         # Radix-tree prefix KV cache (engine/prefix_cache.py): cross-request
         # prompt-head reuse over the paged pool. Worker-thread-owned after
         # start; counters are read cross-thread (queue_stats, GET /cache).
-        self._prefix_cache = RadixPrefixCache(
+        self._prefix_cache = RadixPrefixCache(  # mcpx: owner[engine-worker, atomic]
             self._allocator,
             ecfg.kv_page_size,
             max_nodes=max(0, ecfg.prefix_cache_entries),
@@ -443,7 +446,7 @@ class InferenceEngine:
         # Last-synced cache counters -> Prometheus (the worker folds deltas
         # into mcpx_kv_prefix_* once per iteration, so the cache itself
         # stays metrics-free and single-purpose).
-        self._prefix_seen = {
+        self._prefix_seen = {  # mcpx: owner[engine-worker]
             "hits": 0, "misses": 0, "evictions": 0, "matched_tokens": 0,
         }
         self._prefill_buckets = tuple(
@@ -494,7 +497,7 @@ class InferenceEngine:
         # Speculative-decoding accounting (worker-writes, queue_stats
         # reads): running drafted/accepted totals per row class, swapped in
         # whole like _pending_stats.
-        self._spec_totals = {
+        self._spec_totals = {  # mcpx: owner[engine-worker, atomic]
             "drafted_constrained": 0,
             "accepted_constrained": 0,
             "drafted_free": 0,
@@ -517,7 +520,7 @@ class InferenceEngine:
         # advanced at harvest while any resident row is traced — the
         # residency-delta source for engine.decode span rooflines. Worker
         # thread only.
-        self._seg_cost_totals = {"flops": 0.0, "bytes": 0.0, "wall_s": 0.0}
+        self._seg_cost_totals = {"flops": 0.0, "bytes": 0.0, "wall_s": 0.0}  # mcpx: owner[engine-worker]
 
     # ------------------------------------------------------------- lifecycle
     def _transition(self, to: str) -> bool:
@@ -578,8 +581,11 @@ class InferenceEngine:
             # Drop device buffers (weights + KV pools) so a successor engine
             # in the same process can fit in HBM — only once the worker is
             # actually gone (a still-running batch may hold these).
-            self._params = None
-            self._paged_kv = None
+            # thread-ownership: sanctioned cross-thread teardown — the
+            # branch guard above proves the worker (the owner) is gone, so
+            # there is no concurrent writer left to race.
+            self._params = None  # mcpx: ignore[thread-ownership] - worker joined (guard above); teardown
+            self._paged_kv = None  # mcpx: ignore[thread-ownership] - worker joined (guard above); teardown
             self._jit_prefill = None
             self._seq_mesh = None
             self._jit_admit = None
@@ -594,11 +600,11 @@ class InferenceEngine:
             # drops the cached AOT executables (device programs) so a
             # successor engine fits in HBM.
             self.costs.release()
-            self._stack_cache = None
-            self._inflight.clear()
-            self._pending_admissions.clear()
-            self._dfa_cache.clear()
-            self._prefix_cache.drop_all()
+            self._stack_cache = None  # mcpx: ignore[thread-ownership] - worker joined (guard above); teardown
+            self._inflight.clear()  # mcpx: ignore[thread-ownership] - worker joined (guard above); teardown
+            self._pending_admissions.clear()  # mcpx: ignore[thread-ownership] - worker joined (guard above); teardown
+            self._dfa_cache.clear()  # mcpx: ignore[thread-ownership] - worker joined (guard above); teardown
+            self._prefix_cache.drop_all()  # mcpx: ignore[thread-ownership] - worker joined (guard above); cached KV dies with the pools
         else:
             log.warning(
                 "engine worker still alive after %.1fs join timeout; keeping "
@@ -2734,7 +2740,7 @@ class InferenceEngine:
         )
 
     # --- worker -----------------------------------------------------------
-    def _worker(self) -> None:
+    def _worker(self) -> None:  # mcpx: thread-entry[engine-worker]
         try:
             self._setup()
         except BaseException as e:  # mcpx: ignore[broad-except] - stored as _startup_error, surfaced via start() and /healthz
@@ -3419,7 +3425,7 @@ class InferenceEngine:
                     prng,
                 )
             else:
-                cur0, st0, done0 = self._jit_admit(
+                cur0, st0, done0 = self._jit_admit(  # mcpx: ignore[jit-contract] - homogeneous-mode debt: the slab compat triple admits ONE (temperature, constrained) config per occupancy, so live executables stay bounded by resident configs (warmup precompiles the default); hetero_batch is the structural fix
                     *dfa,
                     last_logits,
                     budgets_d,
@@ -3666,7 +3672,7 @@ class InferenceEngine:
             cur_d, pos_d, st_d, e_d, done_d, k_p, v_p, buf_d, n_fwd = out
         else:
             dfa = self._dfa_for(slab.grammar or self.grammar)
-            out = self._jit_segment(
+            out = self._jit_segment(  # mcpx: ignore[jit-contract] - homogeneous-mode debt: per-request temperature/constrained ARE trace statics here, bounded by the slab-wide compat triple (one config per occupancy, drain-to-switch); hetero_batch moves both into per-row device state
                 self._params,
                 *dfa,
                 cur_d,

@@ -50,8 +50,10 @@ import heapq
 from typing import Any, Optional, Sequence
 
 from mcpx.engine.kv_cache import PageAllocator
+from mcpx.utils.ownership import owned_by
 
 
+@owned_by("engine-worker")
 class PrefixNode:
     """One radix edge: ``tokens`` (length a positive multiple of the page
     size) backed by ``pages`` in the paged pool, allocated under this
@@ -95,8 +97,12 @@ class PrefixNode:
         )
 
 
+@owned_by("engine-worker")
 class RadixPrefixCache:
-    """Worker-thread-owned radix tree over page-aligned prompt heads."""
+    """Worker-thread-owned radix tree over page-aligned prompt heads:
+    the class-level ``owned_by`` puts every instance-attribute write under
+    mcpxlint's thread-ownership pass, and the decorated mutators below
+    make every call path into them prove it starts on the worker."""
 
     def __init__(
         self,
@@ -209,6 +215,7 @@ class RadixPrefixCache:
         return self._descend(ids, limit, mutate=False)[0]
 
     # --------------------------------------------------------------- match
+    @owned_by("engine-worker")
     def match(
         self,
         ids: Sequence[int],
@@ -234,6 +241,7 @@ class RadixPrefixCache:
                 self.misses += 1
         return depth, pages, node
 
+    @owned_by("engine-worker")
     def _split(self, child: PrefixNode, k: int) -> PrefixNode:
         """Split ``child``'s edge at ``k`` tokens (a page boundary):
         insert an intermediate node owning the first ``k`` tokens/pages;
@@ -314,6 +322,7 @@ class RadixPrefixCache:
             node = child
         return node
 
+    @owned_by("engine-worker")
     def insert(
         self, ids: Sequence[int], depth: int, n_tokens: int
     ) -> Optional[PrefixNode]:
@@ -364,6 +373,7 @@ class RadixPrefixCache:
         self._pending_nodes.append(node)
         return node
 
+    @owned_by("engine-worker")
     def seal(self) -> None:
         """Clear the pending flags of everything inserted since the last
         seal: the cohort prefill that writes those nodes' KV has been
@@ -374,6 +384,7 @@ class RadixPrefixCache:
         self._pending_nodes.clear()
 
     # ------------------------------------------------------------ eviction
+    @owned_by("engine-worker")
     def evict(self, need_tokens: int = 0) -> int:
         """Reclaim refcount-0 leaf subtrees, LRU-first, until the tree is
         within its node/token budgets and (when ``need_tokens`` is given)
@@ -422,6 +433,7 @@ class RadixPrefixCache:
                 heapq.heappush(heap, (parent.stamp, seq, parent))
         return freed
 
+    @owned_by("engine-worker")
     def _drop(self, node: PrefixNode) -> None:
         self._alloc.free(node.sid)
         node.parent.children.pop(node.tokens[: self.page_size], None)
@@ -430,6 +442,7 @@ class RadixPrefixCache:
         self.resident_tokens -= len(node.tokens)
         self.evictions += 1
 
+    @owned_by("engine-worker")
     def rollback(self, node: PrefixNode) -> None:
         """Detach a pending node whose prefill was never dispatched (an
         admission unwound by page pressure or a dispatch failure): pages
@@ -442,6 +455,7 @@ class RadixPrefixCache:
         if node in self._pending_nodes:
             self._pending_nodes.remove(node)
 
+    @owned_by("engine-worker")
     def drop_all(self) -> None:
         """Free every node (engine pool reset / shutdown): cached KV lived
         in the old pools and must not be served against new ones."""

@@ -1,0 +1,26 @@
+"""Call-graph golden fixture: direct calls, method calls, an imported
+helper, a thread spawn and a task spawn."""
+import asyncio
+import threading
+
+from .util import helper
+
+
+class Runner:
+    def __init__(self):
+        self.count = 0
+
+    def start(self):
+        threading.Thread(target=self._loop, name="runner").start()
+
+    def _loop(self):
+        self.tick()
+
+    def tick(self):
+        helper()
+
+    async def serve(self):
+        asyncio.create_task(self.handle())
+
+    async def handle(self):
+        self.tick()

@@ -1,0 +1,9 @@
+"""Leaf helpers for the call-graph golden fixture."""
+
+
+def helper():
+    return 1
+
+
+def unused():
+    return 2

@@ -1,0 +1,28 @@
+"""use-after-donation negatives: rebinding from the dispatch outputs
+closes the window, and a sibling `else` arm is not after the dispatch
+(the engine's `_ensure_prefix` shape that once false-positived)."""
+import jax
+import jax.numpy as jnp
+
+
+def _consume(pool):
+    return pool * 2
+
+
+consume = jax.jit(_consume, donate_argnames=("pool",))
+
+
+def dispatch_rebound():
+    pool = jnp.zeros((4,))
+    pool = consume(pool)
+    return pool.sum()
+
+
+def dispatch_branchy(flag):
+    pool = jnp.zeros((4,))
+    if flag:
+        out = consume(pool)
+    else:
+        out = pool.sum()
+    pool = jnp.zeros((4,))
+    return out, pool

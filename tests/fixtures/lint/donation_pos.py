@@ -1,0 +1,18 @@
+"""use-after-donation positive: the pool handed to a donating jitted call
+is read again before being rebound — a deleted buffer at runtime."""
+import jax
+import jax.numpy as jnp
+
+
+def _consume(pool):
+    return pool * 2
+
+
+consume = jax.jit(_consume, donate_argnames=("pool",))
+
+
+def dispatch():
+    pool = jnp.zeros((4,))
+    out = consume(pool)
+    total = pool.sum()
+    return out, total

@@ -1,0 +1,22 @@
+"""Mini jitted engine for the jit-contract fixtures: `step` bakes `width`
+into the executable (static arg), so whoever calls `run_decode` decides
+how many executables exist. Scanned ALONE this file is clean — the taint
+arrives only through a caller in another module."""
+import jax
+import jax.numpy as jnp
+
+
+def _step_impl(x, width):
+    return x[:width] + 1
+
+
+step = jax.jit(_step_impl, static_argnames=("width",))
+
+
+async def run_decode(width):
+    x = jnp.zeros((8,))
+    return step(x, width)
+
+
+def size_bucket(n):
+    return 8 if n <= 8 else 64

@@ -1,0 +1,13 @@
+"""Clean twin of handler_pos: the request value is quantized onto a fixed
+bucket grid before it can reach the static arg — finitely many
+executables by construction, the engine's sanctioned `_bucket` idiom."""
+from .engine_mod import run_decode, size_bucket
+
+
+class PlanRequest:  # mcpx: request-payload
+    max_tokens: int
+
+
+async def handle(req: PlanRequest):
+    n = size_bucket(req.max_tokens)
+    return await run_decode(n)

@@ -1,0 +1,13 @@
+"""A request field crosses a module boundary into a static arg: the PR 7
+retrace-storm shape (one compile per distinct max_tokens) that the
+per-function jit-static-branch rule cannot see."""
+from .engine_mod import run_decode
+
+
+class PlanRequest:  # mcpx: request-payload
+    max_tokens: int
+
+
+async def handle(req: PlanRequest):
+    n = req.max_tokens
+    return await run_decode(n)

@@ -1,0 +1,34 @@
+"""thread-ownership negatives: worker-only mutation paths, GIL-atomic
+cross-thread reads, construction writes, and unowned boundary state."""
+import threading
+
+from mcpx.utils.ownership import owned_by
+
+
+class Tree:
+    @owned_by("worker")
+    def insert(self, k):
+        self.items = k
+
+
+class Service:
+    def __init__(self):
+        self.jobs = []  # mcpx: owner[worker]
+        self.done_count = 0  # mcpx: owner[worker, atomic]
+        self.tree = Tree()
+        self.inbox = []
+
+    def start(self):
+        threading.Thread(target=self._run, name="svc-worker").start()
+
+    def _run(self):  # mcpx: thread-entry[worker]
+        self._step()
+
+    def _step(self):
+        self.jobs.append(1)
+        self.tree.insert(2)
+        self.done_count += 1
+
+    async def handler(self):
+        self.inbox.append("job")  # unowned queue boundary: fine
+        return self.done_count  # atomic read: sanctioned

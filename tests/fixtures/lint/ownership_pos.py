@@ -1,0 +1,37 @@
+"""thread-ownership positives: worker-owned state touched from call paths
+not rooted at the worker's entry point (the pre-fix shape of the engine's
+aclose-era findings: cross-thread teardown writes, unsanctioned
+cross-thread reads, owned-mutator calls from the event loop)."""
+import threading
+
+from mcpx.utils.ownership import owned_by
+
+
+class Tree:
+    @owned_by("worker")
+    def insert(self, k):
+        self.items = k
+
+
+class Service:
+    def __init__(self):
+        self.jobs = []  # mcpx: owner[worker]
+        self.done_count = 0  # mcpx: owner[worker, atomic]
+        self.tree = Tree()
+
+    def start(self):
+        threading.Thread(target=self._run, name="svc-worker").start()
+
+    def _run(self):  # mcpx: thread-entry[worker]
+        self._step()
+
+    def _step(self):
+        self.jobs.append(1)
+        self.done_count += 1
+
+    async def handler(self):
+        self.jobs = []
+        self.jobs.append(2)
+        n = len(self.jobs)
+        self.tree.insert(3)
+        return n + self.done_count

@@ -1,0 +1,11 @@
+"""Cache helpers for the cross-module unbounded-cache-growth fixtures."""
+
+
+def put_bounded(cache, key, value):
+    if len(cache) > 64:
+        cache.popitem()
+    cache[key] = value
+
+
+def put_unbounded(cache, key, value):
+    cache[key] = value

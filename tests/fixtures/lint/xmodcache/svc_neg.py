@@ -1,0 +1,21 @@
+"""unbounded-cache-growth negatives across helper boundaries: the bound
+consult lives in an imported helper (passed the container) or a same-class
+trim method — the false-positive class the dataflow migration killed."""
+from .store import put_bounded
+
+
+class Plans:
+    def __init__(self):
+        self._plan_cache = {}
+
+    def _trim(self):
+        while len(self._plan_cache) > 64:
+            self._plan_cache.popitem()
+
+    async def lookup(self, key, value):
+        put_bounded(self._plan_cache, key, value)
+        self._plan_cache[key] = value
+
+    async def lookup_via_method(self, key, value):
+        self._trim()
+        self._plan_cache[key] = value

@@ -1,0 +1,13 @@
+"""unbounded-cache-growth positive across a helper boundary: the helper
+the container is handed to never consults a bound either — routing
+through a function must not blanket-silence the rule."""
+from .store import put_unbounded
+
+
+class Plans:
+    def __init__(self):
+        self._plan_cache = {}
+
+    async def lookup(self, key, value):
+        put_unbounded(self._plan_cache, key, value)
+        self._plan_cache[key] = value

@@ -1,0 +1,19 @@
+"""unbounded-retry-loop negative across a module boundary: the deadline
+consult lives in an innocuously-named imported helper that raises on
+expiry — invisible to the old per-function rule, resolved by the call
+graph now."""
+from .guard import check_time_left
+
+
+class Client:
+    def __init__(self, session, state):
+        self.session = session
+        self.state = state
+
+    async def fetch(self, url):
+        while True:
+            try:
+                return await self.session.get(url)
+            except OSError:
+                pass
+            check_time_left(self.state)

@@ -1,0 +1,17 @@
+"""unbounded-retry-loop positive across a module boundary: the helper the
+loop calls merely logs — resolving callees must not blanket-silence the
+rule when none of them consults a bound."""
+from .guard import log_failure
+
+
+class Client:
+    def __init__(self, session, state):
+        self.session = session
+        self.state = state
+
+    async def fetch(self, url):
+        while True:
+            try:
+                return await self.session.get(url)
+            except OSError as e:
+                log_failure(e)

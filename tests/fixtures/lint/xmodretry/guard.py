@@ -1,0 +1,10 @@
+"""Helpers for the cross-module unbounded-retry-loop fixtures."""
+
+
+def check_time_left(state):
+    if state.deadline_at < state.now:
+        raise TimeoutError("out of time")
+
+
+def log_failure(exc):
+    print(exc)

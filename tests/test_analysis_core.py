@@ -1,0 +1,227 @@
+"""The interprocedural analysis core (mcpx/analysis/{callgraph,dataflow,
+project}.py): call-graph construction and resolution (golden snapshot over
+a fixture package), backward reachability semantics (spawn edges excluded,
+marked terminals), type inference plumbing, and taint-reachability
+property tests over synthesized call chains of varying depth."""
+
+import pathlib
+import textwrap
+
+import pytest
+
+from mcpx.analysis import scan_paths
+from mcpx.analysis.core import FileContext, _relpath, iter_py_files
+from mcpx.analysis.project import ProjectContext
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+CGPKG = REPO / "tests" / "fixtures" / "lint" / "cgpkg"
+PREFIX = "tests.fixtures.lint.cgpkg."
+
+
+def _project(paths, root):
+    ctxs = [
+        FileContext(p, _relpath(p, root), p.read_text())
+        for p in iter_py_files(paths)
+    ]
+    return ProjectContext(ctxs, root)
+
+
+# ------------------------------------------------------------- call graph
+def test_callgraph_golden_snapshot():
+    """The full edge set over the fixture package: direct method calls,
+    an imported helper, a Thread spawn and a create_task spawn — and the
+    inner `self.handle()` of `create_task(self.handle())` does NOT double
+    as a plain call edge (its body runs in the spawned context)."""
+    proj = _project([CGPKG], REPO)
+    edges = [
+        (c[len(PREFIX):], e[len(PREFIX):], k)
+        for c, e, k in proj.callgraph().summary()
+    ]
+    assert edges == [
+        ("mainmod.Runner._loop", "mainmod.Runner.tick", "call"),
+        ("mainmod.Runner.handle", "mainmod.Runner.tick", "call"),
+        ("mainmod.Runner.serve", "mainmod.Runner.handle", "spawn"),
+        ("mainmod.Runner.start", "mainmod.Runner._loop", "spawn"),
+        ("mainmod.Runner.tick", "util.helper", "call"),
+    ]
+
+
+def test_callgraph_roots_exclude_spawn_edges():
+    """Backward reachability walks plain call edges only: `tick` is
+    reached from `_loop` (whose Thread-spawn in-edge does not count — it
+    is its own terminal) and `handle` (spawned by create_task, likewise
+    terminal). `serve` never appears: its only edge to `handle` is a
+    spawn."""
+    proj = _project([CGPKG], REPO)
+    cg = proj.callgraph()
+    roots = {q[len(PREFIX):] for q in cg.roots_of(PREFIX + "mainmod.Runner.tick")}
+    assert roots == {"mainmod.Runner._loop", "mainmod.Runner.handle"}
+    # a caller-less function is its own root
+    assert cg.roots_of(PREFIX + "util.unused") == frozenset(
+        {PREFIX + "util.unused"}
+    )
+
+
+def test_index_resolves_types_and_imports():
+    proj = _project([CGPKG], REPO)
+    index = proj.index
+    # relative import resolved to the sibling module's function
+    mod = index.modules[PREFIX.rstrip(".") + ".mainmod"]
+    assert mod.imports["helper"] == PREFIX + "util.helper"
+    # constructor-assignment attr typing: Runner().count has no class, but
+    # Runner itself resolves as a class of the module
+    assert PREFIX + "mainmod.Runner" in index.classes
+
+
+# ----------------------------------------------- dataflow reachability
+def _chain_source(n: int, *, sanitize: bool) -> str:
+    """A payload field flowing through ``n`` async helpers into a jitted
+    static arg; with ``sanitize`` the first hop quantizes it."""
+    lines = [
+        "import jax",
+        "import jax.numpy as jnp",
+        "",
+        "",
+        "def _impl(x, k):",
+        "    return x[:k]",
+        "",
+        "",
+        "step = jax.jit(_impl, static_argnames=('k',))",
+        "",
+        "",
+        "def to_bucket(v):",
+        "    return 8 if v <= 8 else 64",
+        "",
+        "",
+        "class Req:  # mcpx: request-payload",
+        "    n: int",
+        "",
+    ]
+    entry = "to_bucket(req.n)" if sanitize else "req.n"
+    lines += [
+        "",
+        "async def handle(req: Req):",
+        f"    await f0({entry})",
+        "",
+    ]
+    for i in range(n):
+        callee = f"f{i + 1}" if i + 1 < n else None
+        lines += ["", f"async def f{i}(v):"]
+        if callee is not None:
+            lines.append(f"    await {callee}(v)")
+        else:
+            lines.append("    step(jnp.zeros((16,)), v)")
+    return "\n".join(lines) + "\n"
+
+
+@pytest.mark.parametrize("depth", [1, 2, 3, 4])
+def test_taint_reaches_static_arg_through_n_hops(tmp_path, depth):
+    p = tmp_path / "chain.py"
+    p.write_text(_chain_source(depth, sanitize=False))
+    res = scan_paths([p], root=tmp_path, rules=["jit-contract"])
+    assert len(res.findings) == 1, [f.render() for f in res.findings]
+    assert "Req.n" in res.findings[0].message
+    assert "static arg 'k'" in res.findings[0].message
+
+
+@pytest.mark.parametrize("depth", [1, 3])
+def test_bucketing_sanitizes_at_any_depth(tmp_path, depth):
+    p = tmp_path / "chain.py"
+    p.write_text(_chain_source(depth, sanitize=True))
+    res = scan_paths([p], root=tmp_path, rules=["jit-contract"])
+    assert res.findings == []
+
+
+def test_taint_flows_through_heap_attributes(tmp_path):
+    """The engine's latch shape: a payload field stored onto an object
+    attribute in one method, read back in another, and fed to a static
+    arg — provenance survives the heap hop."""
+    p = tmp_path / "latch.py"
+    p.write_text(
+        textwrap.dedent(
+            """
+            import jax
+            import jax.numpy as jnp
+
+
+            def _impl(x, k):
+                return x[:k]
+
+
+            step = jax.jit(_impl, static_argnames=('k',))
+
+
+            class Req:  # mcpx: request-payload
+                n: int
+
+
+            class Slab:
+                def __init__(self):
+                    self.width = 0
+
+
+            class Engine:
+                def __init__(self):
+                    self.slab = Slab()
+
+                def admit(self, r: Req):
+                    self.slab.width = r.n
+
+                def dispatch(self):
+                    step(jnp.zeros((16,)), self.slab.width)
+            """
+        )
+    )
+    res = scan_paths([p], root=tmp_path, rules=["jit-contract"])
+    assert len(res.findings) == 1
+    assert "Req.n" in res.findings[0].message
+
+
+def test_unrelated_class_attr_does_not_borrow_taint(tmp_path):
+    """Class-keyed heap cells: a tainted `Slab.width` must not taint
+    `Config.width` reads — the false-positive shape that would poison
+    warmup dispatches fed from config."""
+    p = tmp_path / "split.py"
+    p.write_text(
+        textwrap.dedent(
+            """
+            import jax
+            import jax.numpy as jnp
+
+
+            def _impl(x, k):
+                return x[:k]
+
+
+            step = jax.jit(_impl, static_argnames=('k',))
+
+
+            class Req:  # mcpx: request-payload
+                n: int
+
+
+            class Slab:
+                def __init__(self):
+                    self.width = 0
+
+
+            class Config:
+                def __init__(self):
+                    self.width = 8
+
+
+            class Engine:
+                def __init__(self):
+                    self.slab = Slab()
+                    self.cfg = Config()
+
+                def admit(self, r: Req):
+                    self.slab.width = r.n
+
+                def warmup(self):
+                    step(jnp.zeros((16,)), self.cfg.width)
+            """
+        )
+    )
+    res = scan_paths([p], root=tmp_path, rules=["jit-contract"])
+    assert res.findings == []

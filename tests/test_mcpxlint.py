@@ -33,6 +33,8 @@ RULE_IDS = {
     "unbounded-retry-loop",
     "metric-label-churn",
     "unbounded-cache-growth",
+    "thread-ownership",
+    "jit-contract",
 }
 
 
@@ -136,6 +138,132 @@ def test_unbounded_cache_growth_negative():
     assert hits("unbounded_cache_growth_neg.py", "unbounded-cache-growth") == []
 
 
+# ------------------------------------------------- interprocedural passes
+def test_thread_ownership_positive():
+    # write / two reads / owned-mutator call, all from an async handler
+    # whose call-graph roots never touch the worker's thread entry.
+    assert hits("ownership_pos.py", "thread-ownership") == [33, 34, 35, 36]
+
+
+def test_thread_ownership_negative():
+    # worker-only mutation paths, atomic cross-thread reads, __init__
+    # construction writes and unowned boundary state all stay silent.
+    assert hits("ownership_neg.py", "thread-ownership") == []
+
+
+def test_jit_contract_static_taint_crosses_modules():
+    # The PR 7 retrace-storm shape: req.max_tokens flows handler -> helper
+    # -> static arg `width` across a module boundary the per-function
+    # jit-static-branch rule cannot see. The finding lands at the dispatch.
+    res = scan_paths(
+        [FIXTURES / "jitflow" / "engine_mod.py", FIXTURES / "jitflow" / "handler_pos.py"],
+        root=REPO,
+        rules=["jit-contract"],
+    )
+    assert [(f.path.rsplit("/", 1)[-1], f.line) for f in res.findings] == [
+        ("engine_mod.py", 18)
+    ]
+    assert "max_tokens" in res.findings[0].message
+    # the old per-function rule is blind to it, by construction
+    res_old = scan_paths(
+        [FIXTURES / "jitflow"], root=REPO, rules=["jit-static-branch"]
+    )
+    assert res_old.findings == []
+
+
+def test_jit_contract_bucketed_flow_is_clean():
+    # size_bucket() quantizes the request value onto a fixed grid — the
+    # sanctioned idiom launders the taint.
+    res = scan_paths(
+        [FIXTURES / "jitflow" / "engine_mod.py", FIXTURES / "jitflow" / "handler_neg.py"],
+        root=REPO,
+        rules=["jit-contract"],
+    )
+    assert res.findings == []
+
+
+def test_jit_contract_engine_alone_is_clean():
+    # Without the tainted caller in context there is no request provenance:
+    # the finding is genuinely interprocedural.
+    res = scan_paths(
+        [FIXTURES / "jitflow" / "engine_mod.py"], root=REPO, rules=["jit-contract"]
+    )
+    assert res.findings == []
+
+
+def test_use_after_donation_positive():
+    assert hits("donation_pos.py", "jit-contract") == [17]
+
+
+def test_use_after_donation_negative():
+    # `pool = consume(pool)` rebinds in the dispatch statement itself, and
+    # a sibling `else` arm is not after the dispatch (the engine's
+    # `_ensure_prefix` branch shape that once false-positived).
+    assert hits("donation_neg.py", "jit-contract") == []
+
+
+def test_cache_rule_sees_bound_consults_through_helpers():
+    # Bound consult in an imported helper (container passed as arg) or a
+    # same-class trim method: the migrated rule's killed false positives.
+    res = scan_paths([FIXTURES / "xmodcache"], root=REPO, rules=["unbounded-cache-growth"])
+    assert [(f.path.rsplit("/", 1)[-1], f.line) for f in res.findings] == [
+        ("svc_pos.py", 13)
+    ]
+
+
+def test_retry_rule_sees_bound_consults_through_helpers():
+    # An innocuously-named imported helper that raises on an expired
+    # deadline bounds the loop; a log-only helper does not.
+    res = scan_paths([FIXTURES / "xmodretry"], root=REPO, rules=["unbounded-retry-loop"])
+    assert [(f.path.rsplit("/", 1)[-1], f.line) for f in res.findings] == [
+        ("client_pos.py", 13)
+    ]
+
+
+def test_engine_ownership_annotations_are_live():
+    """The acceptance check behind the clean tree: the real engine files
+    carry the declarations the pass runs on — worker entry, owned fields
+    (atomic where queue_stats reads them), decorated mutators."""
+    from mcpx.analysis.core import FileContext, _relpath, iter_py_files
+    from mcpx.analysis.project import ProjectContext
+    from mcpx.analysis.rules.ownership_rules import _Ownership
+
+    files = iter_py_files([REPO / "mcpx" / "engine", REPO / "mcpx" / "utils"])
+    ctxs = [FileContext(p, _relpath(p, REPO), p.read_text()) for p in files]
+    proj = ProjectContext(ctxs, REPO)
+    own = _Ownership(proj)
+    eng = "mcpx.engine.engine.InferenceEngine"
+    assert (eng, "_inflight") in own.fields
+    assert not own.fields[(eng, "_inflight")][1]  # owner-only, not atomic
+    assert own.fields[(eng, "_ewma_service_s")][1]  # GIL-atomic, cross-read
+    assert proj.index.functions[f"{eng}._worker"].entry_of == "engine-worker"
+    pc = "mcpx.engine.prefix_cache.RadixPrefixCache"
+    assert proj.index.functions[f"{pc}.insert"].owner == "engine-worker"
+    assert proj.index.classes["mcpx.engine.engine._Slab"].owner == "engine-worker"
+    assert (
+        proj.index.functions["mcpx.engine.kv_cache.PageAllocator.free"].owner
+        == "engine-worker"
+    )
+
+
+def test_ownership_pass_guards_real_engine_fields(tmp_path):
+    # A foreign module mutating worker-owned engine state IS flagged — the
+    # annotated tree is clean because nothing violates, not because the
+    # pass is inert.
+    rogue = tmp_path / "rogue.py"
+    rogue.write_text(
+        "from mcpx.engine.engine import InferenceEngine\n\n\n"
+        "async def rogue(engine: InferenceEngine):\n"
+        "    engine._inflight.clear()\n"
+    )
+    res = scan_paths(
+        [REPO / "mcpx" / "engine", REPO / "mcpx" / "utils", rogue],
+        root=REPO,
+        rules=["thread-ownership"],
+    )
+    assert any("rogue" in f.path and "_inflight" in f.message for f in res.findings)
+
+
 def test_committed_baseline_is_empty():
     """ISSUE 3 burn-down: the grandfathered engine.start() state-machine
     findings are fixed for real (guarded transitions), so the baseline is
@@ -215,6 +343,51 @@ def test_suppression_only_judged_against_selected_rules():
     # A blank-lines-only pass must not call the async-blocking suppression
     # unused — that rule never ran.
     res = scan_paths([FIXTURES / "suppressed.py"], root=REPO, rules=["blank-lines"])
+    assert res.findings == []
+
+
+def test_multi_rule_suppression_reports_unfired_known_id(tmp_path):
+    # ignore[a,b] with only `a` firing: `a` is consumed, KNOWN-but-idle `b`
+    # is reported unused — never silently passed.
+    p = tmp_path / "t.py"
+    p.write_text(
+        "import time\n\n\nasync def f():\n"
+        "    time.sleep(1)  # mcpx: ignore[async-blocking,jit-host-sync] - only one fires\n"
+    )
+    res = scan_paths([p], root=tmp_path)
+    assert res.suppressed == 1
+    assert [f.rule for f in res.findings] == ["unused-suppression"]
+    assert "jit-host-sync" in res.findings[0].message
+
+
+def test_unknown_suppression_id_always_reported(tmp_path):
+    # A typo'd id guards nothing; it is reported even when the run's rule
+    # selection wouldn't have judged that rule (unknown ids belong to no
+    # rule, so selection can't exempt them).
+    p = tmp_path / "t.py"
+    p.write_text(
+        "import time\n\n\nasync def f():\n"
+        "    time.sleep(1)  # mcpx: ignore[async-blocking,asnyc-blocking] - typo\n"
+    )
+    res = scan_paths([p], root=tmp_path)
+    assert res.suppressed == 1
+    assert [f.rule for f in res.findings] == ["unused-suppression"]
+    assert "asnyc-blocking" in res.findings[0].message
+    res2 = scan_paths([p], root=tmp_path, rules=["blank-lines"])
+    assert ["asnyc-blocking" in f.message for f in res2.findings] == [True]
+
+
+def test_suppression_groups_merge_and_duplicates_dedupe(tmp_path):
+    # Two ignore[...] groups on one line merge; a duplicated id within a
+    # group dedupes to one suppression, with no spurious unused report.
+    p = tmp_path / "t.py"
+    p.write_text(
+        "import time\n\n\nasync def f():\n"
+        "    time.sleep(1)  "
+        "# mcpx: ignore[async-blocking] - x # mcpx: ignore[async-blocking,async-blocking] - dupe\n"
+    )
+    res = scan_paths([p], root=tmp_path)
+    assert res.suppressed == 1
     assert res.findings == []
 
 
@@ -345,7 +518,162 @@ def test_cli_subcommand_wiring():
                  "--baseline", str(REPO / "does-not-exist.json")]) == 1
 
 
+def test_cli_sarif_format_matches_golden(tmp_path):
+    out = io.StringIO()
+    code = run_lint(
+        [str(FIXTURES / "broad_except_pos.py")],
+        baseline=str(tmp_path / "none.json"),
+        fmt="sarif",
+        root=str(REPO),
+        out=out,
+    )
+    assert code == 1
+    doc = json.loads(out.getvalue())
+    golden = json.loads((FIXTURES / "sarif_golden.json").read_text())
+    assert doc == golden
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "mcpxlint"
+    assert all(
+        r["locations"][0]["physicalLocation"]["region"]["startLine"] > 0
+        for r in run["results"]
+    )
+
+
+def test_cli_changed_scopes_report_to_diff(tmp_path):
+    import subprocess
+
+    def git(*args):
+        subprocess.run(
+            ["git", "-c", "user.email=t@t", "-c", "user.name=t", *args],
+            cwd=tmp_path, check=True, capture_output=True,
+        )
+
+    git("init", "-q")
+    # a committed violation (a.py) and a clean committed file (b.py)...
+    (tmp_path / "a.py").write_text(
+        "import time\n\n\nasync def f():\n    time.sleep(1)\n"
+    )
+    (tmp_path / "b.py").write_text("def ok():\n    return 1\n")
+    git("add", ".")
+    git("commit", "-qm", "seed")
+    # ...then only b.py changes: --changed must report b.py's new finding
+    # and stay silent about a.py's pre-existing one.
+    (tmp_path / "b.py").write_text(
+        "import time\n\n\nasync def g():\n    time.sleep(2)\n"
+    )
+    out = io.StringIO()
+    code = run_lint(
+        [str(tmp_path)],
+        baseline=str(tmp_path / "none.json"),
+        root=str(tmp_path),
+        changed=True,
+        fmt="json",
+        out=out,
+    )
+    payload = json.loads(out.getvalue())
+    assert code == 1
+    assert payload["files_scanned"] == 1
+    assert {f["path"] for f in payload["new"]} == {"b.py"}
+    # per-rule wall time rides the json telemetry
+    assert "async-blocking" in payload["rule_wall_s"]
+    # with a clean working tree (everything committed) --changed is a no-op
+    git("add", ".")
+    git("commit", "-qm", "fixups")
+    out2 = io.StringIO()
+    assert run_lint(
+        [str(tmp_path)], baseline=str(tmp_path / "none.json"),
+        root=str(tmp_path), changed=True, out=out2,
+    ) == 0
+    assert "nothing to lint" in out2.getvalue()
+
+
+def test_cli_changed_works_from_a_repo_subdirectory(tmp_path):
+    # `git diff --name-only` prints toplevel-relative paths; without
+    # --relative a subdirectory root silently drops every tracked change
+    # and reports a false clean.
+    import subprocess
+
+    def git(*args):
+        subprocess.run(
+            ["git", "-c", "user.email=t@t", "-c", "user.name=t", *args],
+            cwd=tmp_path, check=True, capture_output=True,
+        )
+
+    sub = tmp_path / "pkg"
+    sub.mkdir()
+    git("init", "-q")
+    (sub / "mod.py").write_text("def ok():\n    return 1\n")
+    git("add", ".")
+    git("commit", "-qm", "seed")
+    (sub / "mod.py").write_text(
+        "import time\n\n\nasync def f():\n    time.sleep(1)\n"
+    )
+    out = io.StringIO()
+    code = run_lint(
+        [str(sub)], baseline=str(tmp_path / "none.json"), root=str(sub),
+        changed=True, fmt="json", out=out,
+    )
+    payload = json.loads(out.getvalue())
+    assert code == 1
+    assert {f["path"] for f in payload["new"]} == {"mod.py"}
+
+
+def test_cli_changed_leaves_other_files_baseline_alone(tmp_path):
+    # Baseline entries for files outside the diff are neither reported
+    # stale nor wiped by --changed --update-baseline.
+    import subprocess
+
+    def git(*args):
+        subprocess.run(
+            ["git", "-c", "user.email=t@t", "-c", "user.name=t", *args],
+            cwd=tmp_path, check=True, capture_output=True,
+        )
+
+    git("init", "-q")
+    viol = "import time\n\n\nasync def f():\n    time.sleep(1)\n"
+    (tmp_path / "a.py").write_text(viol)
+    (tmp_path / "b.py").write_text("def ok():\n    return 1\n")
+    base = tmp_path / "base.json"
+    save_baseline(base, scan_paths([tmp_path / "a.py"], root=tmp_path).findings)
+    before = load_baseline(base)
+    assert {e["path"] for e in before} == {"a.py"}
+    git("add", ".")
+    git("commit", "-qm", "seed")
+    (tmp_path / "b.py").write_text(viol.replace("def f", "def g"))
+    # check mode: a.py's untouched baselined finding must NOT read as stale
+    out = io.StringIO()
+    code = run_lint(
+        [str(tmp_path)], baseline=str(base), root=str(tmp_path),
+        changed=True, fmt="json", out=out,
+    )
+    payload = json.loads(out.getvalue())
+    assert payload["stale_baseline"] == []
+    assert {f["path"] for f in payload["new"]} == {"b.py"}
+    assert code == 1
+    # update mode: re-baselining the diff preserves a.py's entries
+    assert run_lint(
+        [str(tmp_path)], baseline=str(base), root=str(tmp_path),
+        changed=True, update_baseline=True, out=io.StringIO(),
+    ) == 0
+    after = load_baseline(base)
+    assert [e for e in after if e["path"] == "a.py"] == before
+    assert {e["path"] for e in after} == {"a.py", "b.py"}
+
+
 # ----------------------------------------------------------- tier-1 gate
+def test_full_tree_lint_stays_under_budget():
+    """The interprocedural passes must not silently blow up tier-1 lint
+    time: the full mcpx/ + benchmarks/ scan (call graph, dataflow fixpoint
+    and all) stays well under budget, and the per-rule wall-time telemetry
+    that would show a regression is present."""
+    res = scan_paths([REPO / "mcpx", REPO / "benchmarks"], root=REPO)
+    assert res.duration_s < 25.0, (
+        f"full-tree lint took {res.duration_s:.1f}s; per-rule: "
+        f"{sorted(res.rule_wall_s.items(), key=lambda kv: -kv[1])[:5]}"
+    )
+    assert {"thread-ownership", "jit-contract"} <= set(res.rule_wall_s)
+
+
 def test_tree_is_clean_against_committed_baseline():
     """THE gate: the full analyzer over mcpx/ + benchmarks/ must report
     nothing beyond the committed baseline, and every baseline entry must
