@@ -116,6 +116,16 @@ Environment knobs:
     MCPX_BENCH_PREFIX_INTENTS     unique intents in the phase pool (8)
     MCPX_BENCH_PREFIX_REPS        repeats per unique intent (8)
     MCPX_BENCH_PREFIX_REPLANS     replans timed per mode (6)
+    MCPX_BENCH_TIER      0 skips the tiered KV cache phase (default on):
+                         dedicated small engines drive a working set
+                         >= 10x the HBM-resident radix cap with the
+                         host-RAM spill tier off vs on -> token-hit-rate
+                         retention, per-tenant isolation under an
+                         adversarial thrash tenant, warm-restart
+                         first-plan prefill, and seeded spill chaos
+                         (copy-latency spikes + host-alloc failures)
+    MCPX_BENCH_TIER_PROMPTS       unique prompts in the tier working set (64)
+    MCPX_BENCH_TIER_ROUNDS        round-robin passes over the set (3)
     MCPX_BENCH_OVERLOAD_FACTOR    offered load as a multiple of measured
                                   throughput (default 4)
     MCPX_BENCH_OVERLOAD_REQUESTS  overload-phase request count (default 256)
@@ -1316,6 +1326,337 @@ async def _prefix_phase(cp) -> "dict | None":
     return out
 
 
+async def _tier_phase(cp) -> "dict | None":
+    """Tiered KV cache scenario (ISSUE 11 acceptance): drive a working set
+    >= 10x the HBM-resident radix cap through DEDICATED small engines
+    (same model/vocab as the serving engine, explicit 1x1 mesh, tiny page
+    pool so the cap is cheap to overflow) and compare
+
+      - **single**: ``kv_tier`` off — eviction destroys refcount-0
+        subtrees, so round 2+ of the stream re-prefills almost everything
+        (the cliff).
+      - **tiered**: evicted runs spill to pinned host RAM and re-admit by
+        async page copy on match — the token hit rate holds (the slope).
+
+    Then three sub-probes on the tiered configuration: an ADVERSARIAL
+    THRASH tenant (unique prompts at volume) against a repeat-heavy victim
+    tenant — the governor's weighted-fair quotas keep the victim's token
+    hit rate at its floor; a WARM RESTART (clean aclose writes the KV
+    snapshot, a successor engine restores it into the host tier and serves
+    its first plan from re-admitted KV — first-plan prefill tokens vs the
+    cold engine's); and a CHAOS round (seeded SpillChaos: host-alloc
+    failures + copy-latency spikes) proving the degradation paths serve
+    correctly and count visibly. Greedy outputs are asserted byte-identical
+    tiered-vs-single (tier off is a pass-through, never a quality lever —
+    a parity break fails the bench). Direct ``engine.generate`` with
+    synthetic token-id prompts: this measures the cache machinery, not
+    planning. Skip with MCPX_BENCH_TIER=0."""
+    if os.environ.get("MCPX_BENCH_TIER", "1") == "0":
+        return None
+    serving = getattr(cp.planner, "engine", None)
+    if serving is None or serving.state != "ready":
+        return None
+    import tempfile
+
+    from mcpx.core.config import MCPXConfig
+    from mcpx.engine.engine import InferenceEngine
+
+    n_prompts = max(8, int(os.environ.get("MCPX_BENCH_TIER_PROMPTS", "64")))
+    rounds = max(2, int(os.environ.get("MCPX_BENCH_TIER_ROUNDS", "3")))
+    snap_dir = tempfile.mkdtemp(prefix="mcpx-tier-")
+    snap = os.path.join(snap_dir, "kv.snap")
+
+    def tier_cfg(enabled: bool, *, chaos: str = "", snapshot: str = ""):
+        d = serving.config.to_dict()
+        d["engine"].update(
+            {
+                "data_axis": 1,
+                "model_axis": 1,
+                "warmup_compile": False,
+                "hetero_batch": False,
+                "max_batch_size": 4,
+                "max_pages_per_seq": 16,
+                "kv_page_size": 16,
+                "max_decode_len": 8,
+                "prefix_cache": True,
+                "prefix_cache_entries": 4096,
+            }
+        )
+        d["engine"]["speculative"] = {"enabled": False}
+        d["engine"]["kv_tier"] = {
+            "enabled": enabled,
+            "host_mb": 256.0,
+            "copy_tokens_per_cycle": 4096,
+            "snapshot_path": snapshot,
+            "chaos_profile": chaos,
+        }
+        return MCPXConfig.from_dict(d)
+
+    async def idle(engine) -> None:
+        while engine._slab.n_active or engine._queue.qsize():
+            await asyncio.sleep(0.02)
+        await asyncio.sleep(0.05)
+
+    def prom() -> dict:
+        return _parse_prom(cp.metrics.render().decode())
+
+    tok = serving.tokenizer
+    prompts = [
+        tok.encode(f"tier workload {i}: " + "compose rank fetch join " * 12)[:128]
+        for i in range(n_prompts)
+    ]
+    # The resident device cap of the dedicated geometry — read off the
+    # first constructed engine (run_mode below), never re-derived from
+    # the config constants (a tier_cfg tune must not silently skew the
+    # reported working_set_ratio).
+    cap_tokens = 0
+    working_set = sum(
+        (len(p) // 16) * 16 for p in prompts
+    )  # page-aligned cacheable tokens
+
+    async def drive(engine, stream, *, tenants=None, sink=None) -> tuple[float, float]:
+        """Returns (elapsed_s, first_request_ms) — the first-request wall
+        is the cold/warm first-plan latency probe (symmetric: a fresh
+        engine pays its first-dispatch compiles either way)."""
+        t0 = time.monotonic()
+        first_ms = 0.0
+        for j, p in enumerate(stream):
+            r = await engine.generate(
+                p,
+                max_new_tokens=2,
+                constrained=False,
+                temperature=0.0,
+                tenant=(tenants[j] if tenants else "default"),
+            )
+            if j == 0:
+                first_ms = (time.monotonic() - t0) * 1e3
+            if sink is not None:
+                sink.append(r.token_ids)
+        await idle(engine)
+        return time.monotonic() - t0, first_ms
+
+    async def run_mode(enabled: bool, snapshot: str = "") -> tuple[dict, list, float]:
+        nonlocal cap_tokens
+        engine = InferenceEngine(
+            tier_cfg(enabled, snapshot=snapshot), metrics=cp.metrics
+        )
+        await engine.start()
+        cap_tokens = engine._prefix_cache.max_tokens
+        outs: list = []
+        p0 = prom()
+        elapsed = 0.0
+        first_ms = 0.0
+        for rnd in range(rounds):
+            dt, fms = await drive(
+                engine, prompts, sink=(outs if rnd == 0 else None)
+            )
+            elapsed += dt
+            if rnd == 0:
+                first_ms = fms
+        p1 = prom()
+        prefilled = p1.get("mcpx_engine_prefill_tokens_total", 0.0) - p0.get(
+            "mcpx_engine_prefill_tokens_total", 0.0
+        )
+        matched = p1.get("mcpx_kv_prefix_matched_tokens_total", 0.0) - p0.get(
+            "mcpx_kv_prefix_matched_tokens_total", 0.0
+        )
+        st = engine.prefix_cache_stats()
+        res = {
+            # Matched vs PREFILLED (tokens actually paid for), not the
+            # tree's matched-vs-inserted rate: the single-tier baseline
+            # refuses inserts once full, which would hide every
+            # re-prefilled token from an inserted-based denominator.
+            "token_hit_rate": round(
+                matched / max(1.0, matched + prefilled), 4
+            ),
+            "prefill_tokens_per_request": round(
+                prefilled / (n_prompts * rounds), 1
+            ),
+            "plans_per_sec": round(n_prompts * rounds / max(1e-9, elapsed), 2),
+        }
+        if enabled:
+            t = st["tier"]
+            res.update(
+                spills=t["spills"],
+                readmits=t["readmits"],
+                destructive_evictions=t["destructive_evictions"],
+                host_tokens=t["host_tokens"],
+            )
+        else:
+            res["evictions"] = st["evictions"]
+        res["first_plan_ms"] = round(first_ms, 1)
+        if not snapshot:
+            await engine.aclose()
+            return res, outs, (0.0, 0.0)
+        # Clean close writes the snapshot; report first-plan prefill on
+        # the SUCCESSOR (the warm-restart acceptance number).
+        await engine.aclose()
+        warm = InferenceEngine(tier_cfg(True, snapshot=snapshot), metrics=cp.metrics)
+        await warm.start()
+        wf0 = prom().get("mcpx_engine_prefill_tokens_total", 0.0)
+        t0 = time.monotonic()
+        r = await warm.generate(
+            prompts[0], max_new_tokens=2, constrained=False, temperature=0.0
+        )
+        warm_ms = (time.monotonic() - t0) * 1e3
+        await idle(warm)
+        warm_prefill = prom().get("mcpx_engine_prefill_tokens_total", 0.0) - wf0
+        if r.token_ids != outs[0]:
+            await warm.aclose()
+            raise BenchGateError(
+                "warm-restart output diverged — snapshot KV must attend "
+                "byte-identically to the run that wrote it"
+            )
+        await warm.aclose()
+        return res, outs, (warm_prefill, warm_ms)
+
+    import shutil
+
+    try:
+        return await _tier_phase_body(
+            run_mode, drive, prom, prompts, n_prompts, rounds, snap,
+            cap_getter=lambda: cap_tokens, working_set=working_set,
+            tier_cfg=tier_cfg, cp=cp, tok=tok,
+        )
+    finally:
+        shutil.rmtree(snap_dir, ignore_errors=True)
+
+
+async def _tier_phase_body(
+    run_mode, drive, prom, prompts, n_prompts, rounds, snap, *,
+    cap_getter, working_set, tier_cfg, cp, tok,
+):
+    from mcpx.engine.engine import InferenceEngine
+
+    # --- single-tier baseline.
+    single, single_outs, _ = await run_mode(False)
+    # The cold comparator for the warm-restart probe: a cold engine's
+    # first plan prefills the whole (page-aligned) prompt — deterministic
+    # for this geometry, measured identically by the baseline's round 1.
+    cold_first = float((len(prompts[0]) // 16) * 16)
+
+    # --- tiered + warm restart (same stream, same offered order).
+    tiered, tiered_outs, (warm_first, warm_first_ms) = await run_mode(
+        True, snapshot=snap
+    )
+    if tiered_outs != single_outs:
+        raise BenchGateError(
+            "tiered KV outputs diverged from single-tier on the greedy "
+            "stream — the tier must be a pure residency lever"
+        )
+
+    # --- adversarial thrash tenant vs repeat-heavy victim (governed).
+    gov_engine = InferenceEngine(tier_cfg(True), metrics=cp.metrics)
+    await gov_engine.start()
+    victim_set = prompts[:4]
+    thrash_unique = [
+        tok.encode(f"thrash {i}: " + "spam flood churn " * 14)[:128]
+        for i in range(n_prompts * 2)
+    ]
+    # Interleave: every thrash burst is followed by the victim's repeats.
+    stream: list = []
+    tenants: list = []
+    ti = 0
+    for burst in range(rounds * 4):
+        for _ in range(4):
+            stream.append(thrash_unique[ti % len(thrash_unique)])
+            tenants.append("thrash")
+            ti += 1
+        for v in victim_set:
+            stream.append(v)
+            tenants.append("victim")
+    await drive(gov_engine, stream, tenants=tenants)
+    gstats = gov_engine.prefix_cache_stats()["governor"] or {}
+    victim_thr = (gstats.get("victim") or {}).get("token_hit_rate", 0.0)
+    thrash_thr = (gstats.get("thrash") or {}).get("token_hit_rate", 0.0)
+    await gov_engine.aclose()
+
+    # --- chaos round: seeded faults on the copy paths; serving must stay
+    # correct (greedy parity vs the clean tiered run) and degrade visibly.
+    chaos_profile = {
+        "seed": 7,
+        "host_alloc_fail_p": 0.3,
+        "copy_delay_p": 0.3,
+        "copy_delay_s": 0.02,
+    }
+    chaos_engine = InferenceEngine(
+        tier_cfg(True, chaos=json.dumps(chaos_profile)), metrics=cp.metrics
+    )
+    await chaos_engine.start()
+    chaos_outs: list = []
+    cp0 = prom()
+    await drive(chaos_engine, prompts, sink=chaos_outs)
+    await drive(chaos_engine, prompts)
+    cp1 = prom()
+    c_matched = cp1.get("mcpx_kv_prefix_matched_tokens_total", 0.0) - cp0.get(
+        "mcpx_kv_prefix_matched_tokens_total", 0.0
+    )
+    c_prefilled = cp1.get("mcpx_engine_prefill_tokens_total", 0.0) - cp0.get(
+        "mcpx_engine_prefill_tokens_total", 0.0
+    )
+    cst = chaos_engine.prefix_cache_stats()["tier"]
+    chaos_ok = chaos_outs == single_outs
+    await chaos_engine.aclose()
+    if not chaos_ok:
+        raise BenchGateError(
+            "spill-tier chaos broke greedy output parity — faulted copies "
+            "must degrade to destructive eviction, never serve bad KV"
+        )
+
+    # The single-tier baseline can collapse to EXACTLY zero hits at big
+    # working-set ratios (every run destroyed before its repeat) — floor
+    # the denominator at 1% so the ratio stays a finite, trackable number
+    # instead of a null that reads as "phase didn't run".
+    hit_ratio = round(
+        tiered["token_hit_rate"] / max(single["token_hit_rate"], 0.01), 2
+    )
+    return {
+        "requests": n_prompts * rounds,
+        "rounds": rounds,
+        "working_set_tokens": working_set,
+        "resident_cap_tokens": cap_getter(),
+        "working_set_ratio": round(working_set / max(1, cap_getter()), 2),
+        "single": single,
+        "tiered": tiered,
+        "tier_token_hit_rate": tiered["token_hit_rate"],
+        "tier_hit_ratio": hit_ratio,
+        "spills": tiered["spills"],
+        "readmits": tiered["readmits"],
+        "destructive_evictions": tiered["destructive_evictions"],
+        "tenants": {
+            "victim": {"token_hit_rate": round(victim_thr, 4)},
+            "thrash": {"token_hit_rate": round(thrash_thr, 4)},
+        },
+        "victim_token_hit_rate": round(victim_thr, 4),
+        "tenant_hit_rate_spread": round(victim_thr - thrash_thr, 4),
+        "warm_restart": {
+            "cold_first_plan_prefill_tokens": cold_first,
+            "warm_first_plan_prefill_tokens": warm_first,
+            "prefill_ratio": (
+                round(cold_first / warm_first, 2) if warm_first > 0 else None
+            ),
+            # First-plan wall (ms): both engines pay their first-dispatch
+            # compiles (warmup off), so the comparison is symmetric; the
+            # prefill-token fields above are the mechanism-direct view.
+            "cold_first_plan_ms": single.get("first_plan_ms"),
+            "warm_first_plan_ms": round(warm_first_ms, 1),
+        },
+        "warm_restart_prefill_ratio": (
+            round(cold_first / warm_first, 2) if warm_first > 0 else None
+        ),
+        "chaos": {
+            "profile": chaos_profile,
+            "token_hit_rate": round(
+                c_matched / max(1.0, c_matched + c_prefilled), 4
+            ),
+            "destructive_evictions": cst["destructive_evictions"],
+            "denied_readmits": cst["denied_readmits"],
+            "chaos_alloc_failures": cst["chaos_alloc_failures"],
+            "parity_ok": chaos_ok,
+        },
+    }
+
+
 # Span names -> attribution phase keys (tracing spine, mcpx/telemetry/
 # tracing.py). Per request: scheduler queue wait, engine admit-wait
 # (enqueue -> admission prefill start), cohort prefill, slab-resident
@@ -1770,6 +2111,12 @@ async def _run(model_size: str, n_requests: int, concurrency: int, n_services: i
         # repeat-intent plans through the serving engine).
         prefix = await _prefix_phase(cp)
 
+        # ---- Phase 9: tiered KV cache (ISSUE 11) — dedicated small
+        # engines (working set >= 10x the resident cap, thrash tenant,
+        # warm restart, spill chaos); the serving engine sits idle, so
+        # the shared metric deltas are the tier engines' alone.
+        tier = await _tier_phase(cp)
+
         # ---- Phase 5: latency attribution (ISSUE 4) — a traced open-loop
         # sample at the phase-2 rate; runs after every headline scrape
         # because attaching the tracer is the one thing this phase does
@@ -1919,6 +2266,11 @@ async def _run(model_size: str, n_requests: int, concurrency: int, n_services: i
         # tokens/request and replan p50 with the prefix cache off vs on
         # over a repeat-heavy intent stream at the same offered load.
         "prefix": prefix,
+        # Tiered KV cache scenario (None when skipped): token-hit-rate
+        # retention tiered vs single-tier at a working set >= 10x the
+        # resident cap, per-tenant isolation under adversarial thrash,
+        # warm-restart first-plan prefill, spill-chaos degradation.
+        "tier": tier,
         # Per-phase latency attribution from sampled request traces (None
         # when skipped): p50/p99 of scheduler-queue vs engine admit-wait vs
         # prefill vs decode vs tool fan-out, plus each phase's share of the
@@ -2360,6 +2712,27 @@ def _output_json(stats: dict, quality_trained, model: str) -> dict:
                 "replan_p50_warm_ms": (
                     stats["prefix"]["replan_p50_warm_ms"]
                     if stats["prefix"] else None
+                ),
+                "tier": stats.get("tier"),
+                # Acceptance keys promoted to the top level (ISSUE 11):
+                # tiered-vs-single token hit rate at a >=10x working set,
+                # the victim tenant's isolation floor, and the
+                # warm-restart first-plan prefill ratio.
+                "tier_token_hit_rate": (
+                    stats["tier"]["tier_token_hit_rate"]
+                    if stats.get("tier") else None
+                ),
+                "tier_hit_ratio": (
+                    stats["tier"]["tier_hit_ratio"]
+                    if stats.get("tier") else None
+                ),
+                "victim_token_hit_rate": (
+                    stats["tier"]["victim_token_hit_rate"]
+                    if stats.get("tier") else None
+                ),
+                "warm_restart_prefill_ratio": (
+                    stats["tier"]["warm_restart_prefill_ratio"]
+                    if stats.get("tier") else None
                 ),
                 "latency_attribution": stats["latency_attribution"],
                 "chaos": stats["chaos"],

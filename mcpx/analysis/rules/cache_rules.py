@@ -174,3 +174,108 @@ def check_unbounded_cache_growth(project) -> Iterator[Finding]:
                     "memory leak; bound it (LRU popitem, len() cap, "
                     "evict()) or insert via a bounded helper",
                 )
+
+
+# --------------------------------------------------------------------------
+# evict-without-refcount-consult: reclaim that ignores liveness pins.
+#
+# The bug class (tiered KV cache, engine/prefix_cache.py + spill.py): a
+# cache whose entries carry a reference count — live readers pin an entry;
+# eviction may reclaim only refcount-0 entries — grows an eviction/reclaim
+# path that removes entries WITHOUT consulting the refcount. The race is
+# silent in tests (small working sets rarely evict a pinned entry) and is
+# memory corruption in production: a pinned KV run's pages return to the
+# allocator while a resident slab row's page table still names them.
+#
+# Scope (file): a class is "refcount-aware" when anything in its body reads
+# or writes a `.refs` / `.refcount` / `.pinned` attribute. In such classes,
+# every method whose name mentions evict/reclaim that performs a REMOVAL —
+# `del x[...]`, `.pop/.popitem/.remove/.clear/.free(...)`, or a call whose
+# name mentions "drop" — must consult the refcount in its own scope or in a
+# same-class helper it calls (one hop: the `_device_leaf`-style predicate
+# pattern). Classes without refcounts stay silent: plain LRU caches are the
+# unbounded-cache-growth rule's business, not this one's.
+
+_REF_ATTRS = {"refs", "refcount", "pinned"}
+_REMOVAL_METHODS = {"pop", "popitem", "remove", "clear", "free"}
+
+
+def _reads_refcount(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Attribute) and node.attr in _REF_ATTRS:
+            return True
+    return False
+
+
+def _removals(fn) -> Iterator[int]:
+    """Line numbers of entry-removal operations in ``fn``'s own scope."""
+    for node in walk_scope(fn):
+        if isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript):
+                    yield node.lineno
+                    break
+        elif isinstance(node, ast.Call):
+            name = call_name(node) or ""
+            last = name.rsplit(".", 1)[-1].lower()
+            if isinstance(node.func, ast.Attribute) and (
+                node.func.attr in _REMOVAL_METHODS or "drop" in last
+            ):
+                yield node.lineno
+            elif "drop" in last:
+                yield node.lineno
+
+
+def _class_refcount_aware(cls: ast.ClassDef) -> bool:
+    return _reads_refcount(cls)
+
+
+def _same_class_helpers(cls: ast.ClassDef) -> dict:
+    return {
+        n.name: n
+        for n in cls.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+
+
+def _consults_refcount(fn, helpers: dict) -> bool:
+    """Refcount consult in ``fn``'s own scope, or one hop into a same-class
+    helper it calls (`self._helper(...)`)."""
+    if _reads_refcount(fn):
+        return True
+    for node in walk_scope(fn):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if dotted_name(node.func.value) == "self":
+                callee = helpers.get(node.func.attr)
+                if callee is not None and _reads_refcount(callee):
+                    return True
+    return False
+
+
+@rule(
+    "evict-without-refcount-consult",
+    "Eviction/reclaim path in a refcounted cache removes entries without "
+    "consulting the refcount (pinned entries could be reclaimed under a "
+    "live reader)",
+)
+def check_evict_without_refcount(ctx) -> Iterator[Finding]:
+    for cls in ast.walk(ctx.tree):
+        if not isinstance(cls, ast.ClassDef) or not _class_refcount_aware(cls):
+            continue
+        helpers = _same_class_helpers(cls)
+        for fn in helpers.values():
+            lname = fn.name.lower()
+            if "evict" not in lname and "reclaim" not in lname:
+                continue
+            lines = list(_removals(fn))
+            if not lines or _consults_refcount(fn, helpers):
+                continue
+            yield ctx.finding(
+                lines[0],
+                "evict-without-refcount-consult",
+                f"'{cls.name}.{fn.name}' removes cache entries without "
+                "reading any refs/refcount/pinned attribute (directly or "
+                "via a same-class helper) — a pinned entry could be "
+                "reclaimed under a live reader; gate removal on "
+                "refcount-0 like RadixPrefixCache.evict",
+            )

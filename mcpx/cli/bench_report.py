@@ -56,6 +56,10 @@ TRACKED_METRICS: tuple[tuple[str, str, Optional[str]], ...] = (
     ("prefill_tokens_per_request", "lower", None),
     ("prefix_hit_rate", "higher", None),
     ("replan_p50_warm_ms", "lower", None),
+    ("tier_token_hit_rate", "higher", None),
+    ("tier_hit_ratio", "higher", None),
+    ("victim_token_hit_rate", "higher", None),
+    ("warm_restart_prefill_ratio", "higher", None),
     ("chaos_success_rate", "higher", None),
     ("deadline_overrun_share", "lower", None),
     ("plan_quality_trained.score", "higher", None),

@@ -97,6 +97,47 @@ class SpeculativeConfig:
 
 
 @dataclass
+class KVTierConfig:
+    """Tiered KV cache (mcpx/engine/spill.py + cache_governor.py,
+    docs/engine.md "Tiered KV & cache governance"): a host-RAM spill tier
+    under the radix prefix cache, per-tenant cache governance, and a
+    warm-restart snapshot. Off by default: with ``enabled=false`` (and no
+    ``snapshot_path``) eviction is exactly the pre-tier destructive path —
+    byte-identical pass-through, no tier or governor state touched."""
+
+    enabled: bool = False
+    # Pinned-host byte budget for spilled KV runs. On overrun the tier
+    # first reclaims LRU spilled leaves, then degrades to destructive
+    # eviction (counted, never silent).
+    host_mb: float = 256.0
+    # Device<->host copy-bandwidth budget per admission cycle, in TOKENS
+    # (both directions share it). Spills past the budget degrade to
+    # destructive eviction; readmits past it shrink the match (the request
+    # prefills instead) — spill can never stall admission. 0 = unlimited.
+    copy_tokens_per_cycle: int = 4096
+    # Per-tenant weighted-fair cache quotas (the scheduler's WFQ idea at
+    # the cache layer): an over-quota tenant's inserts evict/spill its OWN
+    # coldest subtrees first, and cross-tenant eviction prefers tenants
+    # over their fair share (deficit-weighted LRU). Weights default to 1.0
+    # per observed tenant; name->weight overrides here.
+    governor: bool = True
+    tenant_weights: dict = field(default_factory=dict)
+    # Warm-restart snapshot: on clean ``aclose()`` the resident prefix
+    # heads (token ids + KV bytes, host-budget-bounded) and governor state
+    # serialize here (versioned manifest + sidecar .npz); the next engine
+    # restores them as host-tier residents, re-admitted by the standard
+    # async page copy on first match. Corrupt/stale snapshots are
+    # detected, logged and skipped — never fatal. "" disables. Requires
+    # ``enabled`` (restored heads live in the host tier).
+    snapshot_path: str = ""
+    # Seeded fault profile for the spill tier (JSON file or inline JSON):
+    # {"seed": 7, "host_alloc_fail_p": 0.1, "copy_delay_p": 0.2,
+    #  "copy_delay_s": 0.05, "snapshot_corrupt": false} — exercised by
+    # bench phase 9 and the resilience tests; "" disables.
+    chaos_profile: str = ""
+
+
+@dataclass
 class EngineConfig:
     # Mesh axis sizes. 0 = auto: cover every visible device (TP over the
     # largest head-dividing factor, keeping a data axis >= 2 when possible —
@@ -232,6 +273,9 @@ class EngineConfig:
     # pressure; 0 disables caching-by-eviction (everything unpinned is
     # reclaimed immediately).
     prefix_cache_entries: int = 512
+    # Tiered KV cache: host-RAM spill under the radix prefix cache,
+    # per-tenant governance, warm-restart snapshot (see KVTierConfig).
+    kv_tier: KVTierConfig = field(default_factory=KVTierConfig)
     # Persistent XLA compilation cache directory ("" disables). Engine
     # startup compiles dozens of (batch, length) bucket executables; the
     # cache makes every startup after the first near-instant for unchanged
@@ -627,6 +671,25 @@ class MCPXConfig:
             problems.append("telemetry.ewma_alpha must be in (0, 1]")
         if self.retrieval.top_k < 1:
             problems.append("retrieval.top_k must be >= 1")
+        kt = self.engine.kv_tier
+        if kt.host_mb < 0:
+            problems.append("engine.kv_tier.host_mb must be >= 0")
+        if kt.copy_tokens_per_cycle < 0:
+            problems.append(
+                "engine.kv_tier.copy_tokens_per_cycle must be >= 0 (0 = unlimited)"
+            )
+        if kt.snapshot_path and not kt.enabled:
+            problems.append(
+                "engine.kv_tier.snapshot_path requires engine.kv_tier.enabled "
+                "(restored heads live in the host spill tier)"
+            )
+        if not isinstance(kt.tenant_weights, dict) or any(
+            not isinstance(v, (int, float)) or v <= 0
+            for v in kt.tenant_weights.values()
+        ):
+            problems.append(
+                "engine.kv_tier.tenant_weights must map tenant -> positive weight"
+            )
         if self.engine.draft_mode not in ("prompt", "off"):
             problems.append(
                 f"engine.draft_mode '{self.engine.draft_mode}' not in prompt|off"

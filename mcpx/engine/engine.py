@@ -48,6 +48,7 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import json
 import logging
 import math
 import queue
@@ -116,6 +117,11 @@ class GenerateRequest:  # mcpx: request-payload
     # deadline cannot afford the wait (scheduler/locality.py). None = no
     # deadline (reorderable freely within the fairness-age bound).
     deadline_at: Optional[float] = None
+    # Cache-governance identity (scheduler grant -> PlanContext ->
+    # GenerateRequest): radix-tree insertions are charged to this tenant,
+    # whose weighted-fair quota bounds its resident KV (cache_governor.py).
+    # Inert ("default") when governance is off or no scheduler runs.
+    tenant: str = "default"
     # Tracing parent (telemetry/tracing.Span) for engine-side attribution:
     # the worker thread hangs queue-wait / prefill / per-segment decode
     # child spans off it via explicit parent.child(t0=..., t1=...) calls —
@@ -435,6 +441,30 @@ class InferenceEngine:
             page_size=ecfg.kv_page_size,
             max_pages_per_seq=ecfg.max_pages_per_seq,
         )
+        # Tiered KV cache (engine/spill.py + cache_governor.py,
+        # EngineConfig.kv_tier): host-RAM spill tier + per-tenant cache
+        # governance under the radix tree. None when disabled — the tree
+        # then behaves byte-identically to the single-tier build.
+        # Worker-thread-owned after start; counters read cross-thread.
+        self._spill_tier = None  # mcpx: owner[engine-worker, atomic]
+        self._governor = None  # mcpx: owner[engine-worker, atomic]
+        if ecfg.kv_tier.enabled:
+            from mcpx.engine.cache_governor import CacheGovernor
+            from mcpx.engine.spill import HostSpillTier, SpillChaos
+
+            chaos = None
+            if ecfg.kv_tier.chaos_profile:
+                try:
+                    chaos = SpillChaos.from_config(ecfg.kv_tier.chaos_profile)
+                except Exception as e:  # noqa: BLE001 - a bad profile must not kill serving
+                    log.warning("spill chaos profile unusable: %s", e)
+            self._spill_tier = HostSpillTier(
+                host_bytes=int(ecfg.kv_tier.host_mb * 1024 * 1024),
+                copy_tokens_per_cycle=ecfg.kv_tier.copy_tokens_per_cycle,
+                chaos=chaos,
+            )
+            if ecfg.kv_tier.governor:
+                self._governor = CacheGovernor(ecfg.kv_tier.tenant_weights)
         # Radix-tree prefix KV cache (engine/prefix_cache.py): cross-request
         # prompt-head reuse over the paged pool. Worker-thread-owned after
         # start; counters are read cross-thread (queue_stats, GET /cache).
@@ -442,7 +472,22 @@ class InferenceEngine:
             self._allocator,
             ecfg.kv_page_size,
             max_nodes=max(0, ecfg.prefix_cache_entries),
+            spill=self._spill_tier,
+            governor=self._governor,
         )
+        # Declared shared-prefix heads observed while serving (token tuple
+        # -> tenant), bounded: the warm-restart snapshot records them.
+        self._declared_heads: "OrderedDict[tuple, str]" = OrderedDict()  # mcpx: owner[engine-worker]
+        # Snapshot heads awaiting their lazy post-restart rebuild (only
+        # used when a snapshot carried ids but its KV could not be
+        # restored): (ids tuple, tenant), consumed on first matching use.
+        self._warm_heads: list[tuple[tuple, str]] = []  # mcpx: owner[engine-worker]
+        # Last-synced spill counters -> Prometheus (delta fold, exactly
+        # like _prefix_seen below).
+        self._spill_seen = {  # mcpx: owner[engine-worker]
+            "spills": 0, "readmits": 0, "destructive_evictions": 0,
+            "host_evictions": 0, "denied_readmits": 0,
+        }
         # Last-synced cache counters -> Prometheus (the worker folds deltas
         # into mcpx_kv_prefix_* once per iteration, so the cache itself
         # stays metrics-free and single-purpose).
@@ -584,6 +629,28 @@ class InferenceEngine:
             # thread-ownership: sanctioned cross-thread teardown — the
             # branch guard above proves the worker (the owner) is gone, so
             # there is no concurrent writer left to race.
+            if (
+                self._spill_tier is not None
+                and self.config.engine.kv_tier.snapshot_path
+                and self._started.is_set()
+                and self._startup_error is None
+                and self._params is not None  # mcpx: ignore[thread-ownership] - worker joined (guard above); teardown
+            ):
+                # CLEAN close: persist the warm-restart snapshot before the
+                # pools drop (worker joined — no writer left to race; an
+                # unclean close, startup failure, or prior snapshot just
+                # skips). An in-flight spill/readmit copy joins here via
+                # the tier's blocking drain, so no host buffer leaks and
+                # no freed page run is read after the pools die.
+                try:
+                    self._save_snapshot()
+                except Exception:  # noqa: BLE001 - a deploy never hangs on its snapshot
+                    log.warning("KV snapshot save failed", exc_info=True)
+            if self._spill_tier is not None:
+                # Drop pending copy handles + host buffers (post-snapshot):
+                # aclose during an in-flight spill must leave no orphaned
+                # pinned memory and no dangling device references.
+                self._spill_tier.reset()  # mcpx: ignore[thread-ownership] - worker joined (guard above); teardown
             self._params = None  # mcpx: ignore[thread-ownership] - worker joined (guard above); teardown
             self._paged_kv = None  # mcpx: ignore[thread-ownership] - worker joined (guard above); teardown
             self._jit_prefill = None
@@ -596,6 +663,8 @@ class InferenceEngine:
             self._jit_hetero_admit = None
             self._jit_hetero_segment = None
             self._jit_hetero_segment_spec = None
+            self._jit_spill_gather = None
+            self._jit_spill_readmit = None
             # Cost registry keeps its compile/cost history readable but
             # drops the cached AOT executables (device programs) so a
             # successor engine fits in HBM.
@@ -624,6 +693,7 @@ class InferenceEngine:
         grammar: Optional[PlanGrammar] = None,
         shared_prefix_len: int = 0,
         deadline_at: Optional[float] = None,
+        tenant: str = "default",
     ) -> GenerateResult:
         if self.state != "ready":
             raise EngineError(f"engine not ready (state={self.state})")
@@ -644,6 +714,7 @@ class InferenceEngine:
                 grammar=grammar,
                 shared_prefix_len=shared_prefix_len if ecfg.prefix_cache else 0,
                 deadline_at=deadline_at,
+                tenant=tenant or "default",
                 span=esp,
             )
             self._queue.put(req)
@@ -682,11 +753,22 @@ class InferenceEngine:
 
     def prefix_cache_stats(self) -> dict:
         """Cross-thread counter snapshot of the radix prefix cache (the
-        ``GET /cache`` surface); ``enabled`` reflects the live config."""
-        return {
+        ``GET /cache`` surface); ``enabled`` reflects the live config.
+        With the tiered cache armed, ``tier`` carries the host-RAM spill
+        accounting (resident host tokens/bytes, spills/readmits/
+        destructive evictions) and ``governor`` the per-tenant residency
+        and hit-rate spread; both are None single-tier."""
+        out = {
             "enabled": bool(self.config.engine.prefix_cache),
             **self._prefix_cache.stats(),
+            "tier": None,
+            "governor": None,
         }
+        if self._spill_tier is not None:
+            out["tier"] = {"enabled": True, **self._spill_tier.stats()}
+        if self._governor is not None:
+            out["governor"] = self._governor.stats(self._prefix_cache.max_tokens)
+        return out
 
     def queue_stats(self) -> dict:
         """Cross-thread snapshot of engine load for the serving scheduler
@@ -726,11 +808,21 @@ class InferenceEngine:
         # rates — what the locality-aware admission sort is working with,
         # published for the serving scheduler and /healthz.
         ps_pfx = self._prefix_cache.stats()
+        tier = self._spill_tier
         return {
             "prefix_nodes": ps_pfx["nodes"],
             "prefix_resident_pages": ps_pfx["resident_pages"],
             "prefix_hit_rate": ps_pfx["hit_rate"],
             "prefix_token_hit_rate": ps_pfx["token_hit_rate"],
+            # Tiered-cache scoreboard (zeros single-tier): host-resident
+            # pages and the spill/readmit/destructive-eviction tallies the
+            # prefix-affinity router and /healthz watch.
+            "prefix_host_pages": ps_pfx["host_pages"],
+            "prefix_spills": tier.spills if tier is not None else 0,
+            "prefix_readmits": tier.readmits if tier is not None else 0,
+            "prefix_destructive_evictions": (
+                tier.destructive_evictions if tier is not None else 0
+            ),
             "depth": depth,
             "active": active,
             "service_ewma_s": svc,
@@ -933,6 +1025,35 @@ class InferenceEngine:
             ),
             static_argnames=("iters", "K", "draft"),
         )
+        if self._spill_tier is not None:
+            # Tiered KV cache: the device<->host page-run copies. One
+            # gather and one scatter executable per page-count bucket
+            # (run lengths pad up to a power of two); the scatter donates
+            # the pools exactly like prefill — the readmitted data is
+            # device-ordered ahead of any dispatch that reads it.
+            self._jit_spill_gather = wrap(
+                "spill_gather", jax.jit(self._spill_gather_impl)
+            )
+            self._jit_spill_readmit = wrap(
+                "spill_readmit",
+                jax.jit(
+                    self._spill_readmit_impl,
+                    donate_argnames=("paged_k", "paged_v"),
+                ),
+            )
+            mc = self.model_cfg
+            kv_bytes_per_token = (
+                2
+                * mc.n_kv_heads
+                * mc.n_layers
+                * mc.head_dim
+                * jnp.dtype(mc.dtype).itemsize
+            )
+            self._spill_tier.bind(
+                self._spill_gather_dispatch,
+                self._spill_readmit_dispatch,
+                kv_bytes_per_token,
+            )
         try:
             # Datasheet peaks over the chips this engine actually meshes:
             # the denominator for span roofline attrs. None off-TPU (spans
@@ -1001,6 +1122,8 @@ class InferenceEngine:
             # is scored against the tied unembedding).
             draft_dim=self.model_cfg.d_model,
         )
+        if self._spill_tier is not None and ecfg.kv_tier.snapshot_path:
+            self._load_snapshot()
         if ecfg.warmup_compile:
             self._warmup()
 
@@ -1902,7 +2025,298 @@ class InferenceEngine:
         )
         return last, kv["k"], kv["v"]
 
-    def _ensure_prefix(self, key: tuple) -> Optional[PrefixNode]:
+    # --- tiered KV cache: device<->host page-run copies -------------------
+    def _spill_gather_impl(self, paged_k, paged_v, pages):
+        """Async device→host spill, step 1: slice the named pages out of
+        the pools (functional snapshot — later pool writes cannot touch
+        the result). Pad lanes carry the null page's garbage; the readmit
+        scatter drops them."""
+        return paged_k[:, :, pages], paged_v[:, :, pages]
+
+    def _spill_readmit_impl(self, paged_k, paged_v, k_run, v_run, pages):
+        """Host→device readmit: scatter a spilled run back into freshly-
+        allocated pages. Pad lanes index out of range and drop."""
+        return (
+            paged_k.at[:, :, pages].set(k_run, mode="drop"),
+            paged_v.at[:, :, pages].set(v_run, mode="drop"),
+        )
+
+    @staticmethod
+    def _spill_bucket(n: int) -> int:
+        """Page-count pad bucket (next power of two): one gather/scatter
+        executable per bucket, not per run length."""
+        b = 1
+        while b < n:
+            b <<= 1
+        return b
+
+    @owned_by("engine-worker")
+    def _spill_gather_dispatch(self, pages: list[int]) -> tuple:
+        """HostSpillTier's gather hook: dispatch the page-run slice on the
+        CURRENT pools and return the async device handles (the tier polls
+        them off the hot path). No donation — the pools stay live."""
+        B = self._spill_bucket(len(pages))
+        arr = np.zeros((B,), np.int32)  # pad -> null page (content unused)
+        arr[: len(pages)] = pages
+        return self._jit_spill_gather(
+            self._paged_kv["k"],
+            self._paged_kv["v"],
+            self._put(arr, P()),
+        )
+
+    @owned_by("engine-worker")
+    def _spill_readmit_dispatch(self, k_host, v_host, pages: list[int]) -> None:
+        """HostSpillTier's readmit hook: async host→device scatter into
+        ``pages``, donating the pools like every prefill — dispatched
+        before anything that reads the pages, so device program order
+        makes the data visible with no host sync."""
+        # Pad the run to its page-count bucket (host-run splits produce
+        # arbitrary lengths; one scatter executable per bucket, never per
+        # length). Pad lanes index out of range and drop.
+        k_host, v_host = np.asarray(k_host), np.asarray(v_host)
+        B = self._spill_bucket(max(len(pages), k_host.shape[2]))
+        if k_host.shape[2] < B:
+            pad = [(0, 0)] * k_host.ndim
+            pad[2] = (0, B - k_host.shape[2])
+            k_host = np.pad(k_host, pad)
+            v_host = np.pad(v_host, pad)
+        arr = np.full((B,), self._allocator.n_pages, np.int32)  # pad -> drop
+        arr[: len(pages)] = pages
+        k_p, v_p = self._jit_spill_readmit(
+            self._paged_kv["k"],
+            self._paged_kv["v"],
+            self._put(k_host, P()),
+            self._put(v_host, P()),
+            self._put(arr, P()),
+        )
+        self._paged_kv = {"k": k_p, "v": v_p}
+
+    # --- tiered KV cache: warm-restart snapshot ---------------------------
+    _SNAPSHOT_VERSION = 1
+
+    def _snapshot_meta(self) -> dict:
+        mc = self.model_cfg
+        return {
+            "version": self._SNAPSHOT_VERSION,
+            "page_size": self.config.engine.kv_page_size,
+            "n_kv_heads": mc.n_kv_heads,
+            "n_layers": mc.n_layers,
+            "head_dim": mc.head_dim,
+            "dtype": str(jnp.dtype(mc.dtype).name),
+            "vocab_size": self.tokenizer.vocab_size,
+        }
+
+    def _params_fingerprint(self) -> Optional[float]:
+        """Cheap identity check that the restoring engine serves the SAME
+        weights the snapshot's KV was computed under (random-init runs are
+        seeded, so the fingerprint is stable per config; a checkpoint swap
+        changes it and the KV restore is skipped — stale KV must never be
+        attended)."""
+        try:
+            leaves = jax.tree_util.tree_leaves(self._params)  # mcpx: ignore[thread-ownership] - worker thread (setup) or post-join teardown (aclose guard)
+            total = 0.0
+            for i, leaf in enumerate(leaves):
+                # Position-weighted abs-sum over EVERY leaf: a fine-tune
+                # that leaves any single tensor untouched (frozen
+                # embeddings, a norm scale) still shifts the total, and
+                # leaf permutations cannot cancel. Snapshot-path only —
+                # never on the serving path.
+                total += (i + 1.0) * float(
+                    jnp.sum(jnp.abs(leaf).astype(jnp.float32))
+                )
+            return total
+        except Exception:  # noqa: BLE001 - no fingerprint = no KV restore
+            log.debug("params fingerprint unavailable", exc_info=True)
+            return None
+
+    def _save_snapshot(self) -> None:
+        """Serialize the warm-restart snapshot: a versioned JSON manifest
+        (tree structure, declared heads, governor state, model identity)
+        plus a sidecar ``.npz`` of KV page runs, bounded by the tier's
+        host byte budget, written atomically. Called from aclose() AFTER
+        the worker joined (single-writer preserved: no writer left) and
+        BEFORE the pools drop. Best-effort — any failure logs and skips;
+        a deploy must never hang on its snapshot."""
+        import os
+
+        ecfg = self.config.engine
+        path = os.path.expanduser(ecfg.kv_tier.snapshot_path)
+        tier = self._spill_tier
+        cache = self._prefix_cache
+        psz = ecfg.kv_page_size
+        tier.drain()  # mcpx: ignore[thread-ownership] - worker joined (aclose guard); blocking shutdown drain
+        nodes_out: list[dict] = []
+        arrays: dict[str, Any] = {}
+        budget = tier.host_bytes or (256 << 20)
+        total = 0
+        # Root-first BFS so every manifest entry's parent precedes it —
+        # the restore contract of RadixPrefixCache.restore_spilled.
+        queue = [(cache.root, ())]
+        while queue:
+            node, prefix = queue.pop(0)
+            for child in node.children.values():
+                cpath = prefix + child.tokens
+                if child.pending:
+                    continue
+                if child.host is not None and child.host.ready:
+                    k_np, v_np = child.host.k, child.host.v
+                elif child.pages:
+                    pages = np.asarray(child.pages, np.int32)
+                    k_np, v_np = jax.device_get(
+                        (
+                            self._paged_kv["k"][:, :, pages],  # mcpx: ignore[thread-ownership] - worker joined (aclose guard); teardown read
+                            self._paged_kv["v"][:, :, pages],  # mcpx: ignore[thread-ownership] - worker joined (aclose guard); teardown read
+                        )
+                    )
+                else:
+                    continue
+                nbytes = int(k_np.nbytes) + int(v_np.nbytes)
+                if total + nbytes > budget:
+                    continue  # keep walking: a smaller sibling may fit
+                total += nbytes
+                key = f"n{len(nodes_out)}"
+                arrays[f"{key}_k"] = np.frombuffer(
+                    np.ascontiguousarray(k_np).tobytes(), np.uint8
+                )
+                arrays[f"{key}_v"] = np.frombuffer(
+                    np.ascontiguousarray(v_np).tobytes(), np.uint8
+                )
+                nodes_out.append(
+                    {
+                        "path": [int(t) for t in cpath],
+                        "edge": len(child.tokens),
+                        "tenant": child.tenant,
+                        "key": key,
+                        "shape": list(k_np.shape),
+                    }
+                )
+                queue.append((child, cpath))
+        manifest = {
+            **self._snapshot_meta(),
+            "fingerprint": self._params_fingerprint(),
+            "governor": (
+                self._governor.snapshot() if self._governor is not None else {}
+            ),
+            "declared_heads": [
+                {"ids": [int(t) for t in k], "tenant": t}
+                for k, t in self._declared_heads.items()  # mcpx: ignore[thread-ownership] - worker joined (aclose guard); teardown read
+            ],
+            "nodes": nodes_out,
+        }
+        chaos = tier.chaos
+        tmp = path + ".tmp"
+        npz_tmp = path + ".npz.tmp"
+        with open(npz_tmp, "wb") as f:
+            np.savez(f, **arrays)
+        os.replace(npz_tmp, path + ".npz")
+        with open(tmp, "w") as f:
+            if chaos is not None and chaos.snapshot_corrupt:
+                f.write(json.dumps(manifest)[: 40] + "...TRUNCATED")
+            else:
+                json.dump(manifest, f)
+        os.replace(tmp, path)
+        log.info(
+            "KV snapshot saved: %d runs, %.1f MiB, %d declared heads -> %s",
+            len(nodes_out), total / (1 << 20),
+            len(self._declared_heads),  # mcpx: ignore[thread-ownership] - worker joined (aclose guard); teardown read
+            path,
+        )
+
+    def _load_snapshot(self) -> None:
+        """Restore a warm-restart snapshot written by a prior clean
+        ``aclose()``: validated manifest entries become SPILLED tree nodes
+        (host-resident KV, re-admitted by the standard async page copy on
+        first match — deploys start warm with zero prefill). Corrupt,
+        stale or mismatched snapshots are detected, logged and SKIPPED —
+        never fatal, never attended. Worker thread, during _setup."""
+        import os
+
+        path = os.path.expanduser(self.config.engine.kv_tier.snapshot_path)
+        if not os.path.exists(path):
+            return
+        try:
+            with open(path) as f:
+                manifest = json.load(f)
+            meta = self._snapshot_meta()
+            for k, want in meta.items():
+                if manifest.get(k) != want:
+                    raise ValueError(
+                        f"snapshot {k}={manifest.get(k)!r} != engine {want!r}"
+                    )
+        except Exception as e:  # noqa: BLE001 - corrupt/stale snapshot: skip, never fatal
+            log.warning("KV snapshot unusable, starting cold: %s", e)
+            return
+        if self._governor is not None:
+            try:
+                self._governor.restore(manifest.get("governor") or {})
+            except Exception:  # noqa: BLE001 - governor state is advisory
+                log.warning("snapshot governor state unusable", exc_info=True)
+        heads = [
+            (tuple(int(t) for t in h.get("ids", ())), str(h.get("tenant", "default")))
+            for h in manifest.get("declared_heads", ())
+            if h.get("ids")
+        ]
+        fp_then = manifest.get("fingerprint")
+        fp_now = self._params_fingerprint()
+        kv_ok = (
+            fp_then is not None
+            and fp_now is not None
+            and abs(fp_then - fp_now) <= 1e-3 * max(1.0, abs(fp_then))
+        )
+        restored = 0
+        if kv_ok:
+            try:
+                npz = np.load(path + ".npz")
+                dtype = jnp.dtype(self.model_cfg.dtype)
+                for ent in manifest.get("nodes", ()):
+                    shape = tuple(int(s) for s in ent["shape"])
+                    k_np = np.frombuffer(
+                        npz[ent["key"] + "_k"].tobytes(), dtype
+                    ).reshape(shape)
+                    v_np = np.frombuffer(
+                        npz[ent["key"] + "_v"].tobytes(), dtype
+                    ).reshape(shape)
+                    if self._prefix_cache.restore_spilled(
+                        [int(t) for t in ent["path"]],
+                        int(ent["edge"]),
+                        k_np,
+                        v_np,
+                        str(ent.get("tenant", "default")),
+                    ):
+                        restored += 1
+            except Exception as e:  # noqa: BLE001 - partial restore is still a win; the rest rebuilds lazily
+                log.warning("KV snapshot arrays unusable past %d runs: %s", restored, e)
+        if not kv_ok or restored == 0:
+            # KV invalid (weights changed, arrays corrupt): fall back to
+            # lazily re-prefilling the declared heads on first use.
+            self._warm_heads = [h for h in heads if h[0]]
+            log.info(
+                "KV snapshot ids-only restore: %d heads queued for lazy "
+                "re-prefill (kv_ok=%s)", len(self._warm_heads), kv_ok,
+            )
+        else:
+            log.info("KV snapshot restored %d runs into the host tier", restored)
+        for k, t in heads:
+            self._declared_heads[k] = t
+
+    def _pop_warm_head(self, req: GenerateRequest) -> Optional[tuple]:
+        """The longest snapshot head strictly prefixing ``req``'s prompt
+        (ids-only restore fallback), popped for its one lazy rebuild."""
+        best = None
+        best_i = -1
+        for i, (ids, tenant) in enumerate(self._warm_heads):
+            if len(ids) < len(req.prompt_ids) and tuple(
+                req.prompt_ids[: len(ids)]
+            ) == ids:
+                if best is None or len(ids) > len(best[0]):
+                    best, best_i = (ids, tenant), i
+        if best is not None:
+            self._warm_heads.pop(best_i)
+        return best
+
+    def _ensure_prefix(
+        self, key: tuple, tenant: str = "default"
+    ) -> Optional[PrefixNode]:
         """Make the declared shared prompt head ``key`` fully resident in
         the radix tree, prefilling only the part the tree does not already
         hold (one [1, T] dispatch — suffix-offset when a head is matched,
@@ -1933,7 +2347,7 @@ class InferenceEngine:
         T = _bucket(R, eligible)
         if mnode is not None:
             mnode.refs += 1  # hold: the build below may evict under pressure
-        node = cache.insert(key, n, R)
+        node = cache.insert(key, n, R, tenant=tenant)
         if mnode is not None:
             mnode.refs -= 1
         if node is None:
@@ -2759,6 +3173,10 @@ class InferenceEngine:
                 break
             self._refresh_queue_gauges(pending)
             self._poll_admissions(slab)
+            if self._spill_tier is not None:
+                # Complete landed device->host spill fetches (non-blocking
+                # is_ready polls; a no-op scan when nothing is in flight).
+                self._spill_tier.poll()
             self._reap_cancelled(slab)
             if pending and slab.n_active < slab.B:
                 try:
@@ -2850,6 +3268,29 @@ class InferenceEngine:
         self.metrics.prefix_shared_pages.set(
             c.resident_tokens // max(1, c.page_size)
         )
+        tier = self._spill_tier
+        if tier is not None:
+            seen = self._spill_seen
+            for attr, metric in (
+                ("spills", self.metrics.kv_spills),
+                ("readmits", self.metrics.kv_readmits),
+                ("destructive_evictions", self.metrics.kv_destructive_evictions),
+                ("host_evictions", self.metrics.kv_host_evictions),
+                ("denied_readmits", self.metrics.kv_denied_readmits),
+            ):
+                cur = getattr(tier, attr)
+                if cur > seen[attr]:
+                    metric.inc(cur - seen[attr])
+                    seen[attr] = cur
+            self.metrics.kv_host_tokens.set(tier.host_tokens)
+            self.metrics.kv_host_bytes.set(tier.host_bytes_used)
+        if self._governor is not None:
+            for tenant, tokens in self._governor.resident_by_tenant().items():
+                # Bounded label space: the governor folds tenants past its
+                # cardinality cap into "other" before they reach here.
+                self.metrics.kv_tenant_resident_tokens.labels(
+                    tenant=tenant
+                ).set(tokens)
 
     def _drain_queue(self, pending: "deque[GenerateRequest]", block: bool) -> None:
         """Move queued requests into ``pending``. When idle (``block``), wait
@@ -2931,6 +3372,11 @@ class InferenceEngine:
         free = slab.free_rows()
         if not free or not pending:
             return
+        if self._spill_tier is not None:
+            # New admission cycle: reset the tier's copy-bandwidth budget
+            # (spills and readmits both draw on it; overruns degrade to
+            # destructive eviction / shorter matches, never a stall).
+            self._spill_tier.begin_cycle()
         if slab.n_active == 0:
             slab.hetero = ecfg.hetero_batch  # mode latch: see _Slab.hetero
             slab.spec_k = self._spec_k()  # speculative latch, same rules
@@ -2994,14 +3440,42 @@ class InferenceEngine:
         head_key = (
             head_req.prefix_key(ecfg.kv_page_size) if ecfg.prefix_cache else None
         )
-        if head_key is not None:
+        warm_head = (
+            self._pop_warm_head(head_req)
+            if ecfg.prefix_cache and self._warm_heads
+            else None
+        )
+        if head_key is not None and self._spill_tier is not None:
+            # Warm-restart bookkeeping: the snapshot records the declared
+            # heads this engine actually served (bounded LRU).
+            self._declared_heads[head_key] = head_req.tenant
+            self._declared_heads.move_to_end(head_key)
+            while len(self._declared_heads) > 64:
+                self._declared_heads.popitem(last=False)
+        if head_key is not None or warm_head is not None:
             # Cold-start sharing: make the DECLARED shared head resident in
             # the radix tree before the cohort prefills, so even the first
             # burst's rows share it instead of each prefilling its own copy
             # (per-row matching below picks it up like any resident path).
+            # A snapshot head whose KV could not be restored rebuilds here
+            # too — lazily, on its first matching use after restart.
             try:
-                hold = self._ensure_prefix(head_key)
+                if warm_head is not None:
+                    if (
+                        self._ensure_prefix(warm_head[0], tenant=warm_head[1])
+                        is None
+                    ):
+                        # Build refused (page pressure / geometry): requeue
+                        # the head — it retries on the next matching
+                        # request instead of being silently lost.
+                        self._warm_heads.append(warm_head)
+                if head_key is not None:
+                    hold = self._ensure_prefix(head_key, tenant=head_req.tenant)
             except BaseException as e:  # noqa: BLE001 - prefill donated pools
+                if warm_head is not None:
+                    # The popped snapshot head must survive the failure —
+                    # it retries on the next matching request.
+                    self._warm_heads.append(warm_head)
                 log.exception("prefix build failed; failing resident rows")
                 self._fail_rows(slab, e)
                 self._reset_pools()
@@ -3242,7 +3716,7 @@ class InferenceEngine:
             if use_prefix:
                 want = ((P + len(ids)) // psz) * psz - P
                 if want > 0:
-                    inode = cache.insert(r.prompt_ids, P, want)
+                    inode = cache.insert(r.prompt_ids, P, want, tenant=r.tenant)
                     if inode is not None:
                         ins = want
             need = len(ids) - ins + budget + slack
@@ -3269,6 +3743,11 @@ class InferenceEngine:
                     cache.matched_tokens += P
                 else:
                     cache.misses += 1
+                if self._governor is not None:
+                    # Per-tenant reuse accounting: matched vs prefilled
+                    # tokens — the per-tenant hit-rate spread GET /cache
+                    # and bench phase 9's isolation gate read.
+                    self._governor.on_lookup(r.tenant, P, len(ids))
             cohort.append(r)
             prompts.append(ids)
             budgets.append(budget)

@@ -35,6 +35,11 @@ class PlanContext:
     # admission sort never regroups a request whose deadline can't afford
     # the wait (scheduler/locality.py). None = no deadline.
     deadline_at: Optional[float] = None
+    # Cache-governance identity (scheduler grant / tenant header), threaded
+    # to the engine so radix-tree KV insertions are charged to the tenant's
+    # weighted-fair cache quota (engine/cache_governor.py). "default" =
+    # single-tenant traffic (no quota pressure).
+    tenant: str = "default"
     # Warm-replan rendering order (names, as originally rendered): when set
     # alongside ``exclude``, the LLM planner keeps these services in the
     # prompt IN THIS ORDER — excluded ones included — and splices the

@@ -281,6 +281,7 @@ class LLMPlanner:
                 grammar=grammar,
                 shared_prefix_len=len(prefix_ids),
                 deadline_at=context.deadline_at,
+                tenant=context.tenant,
             )
             repaired = False
             try:

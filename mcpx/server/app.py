@@ -90,6 +90,13 @@ def build_app(cp: ControlPlane) -> web.Application:
     server_cfg = cp.config.server
     inflight = {"n": 0}
 
+    def _tenant_of(request: web.Request) -> str:
+        """Cache-governance tenant when no scheduler grant carries one:
+        the scheduler-config tenant header directly (same name either
+        way, so enabling the scheduler never changes a client's identity
+        contract). Absent header = single-tenant "default"."""
+        return request.headers.get(cp.config.scheduler.tenant_header) or "default"
+
     @web.middleware
     async def observability(request: web.Request, handler) -> web.StreamResponse:
         """Every request: root tracing span (W3C ``traceparent`` in/out),
@@ -227,6 +234,15 @@ def build_app(cp: ControlPlane) -> web.Application:
                 # prefix-locality admission never regroups a request whose
                 # deadline can't afford the wait (scheduler/locality.py).
                 deadline_at=slot.ctx.deadline_at if slot is not None else None,
+                # Cache-governance identity: the grant's tenant, or the
+                # tenant header directly when no scheduler is attached —
+                # the engine's cache governor charges radix-tree KV
+                # residency to it (engine/cache_governor.py).
+                tenant=(
+                    slot.ctx.tenant
+                    if slot is not None
+                    else _tenant_of(request)
+                ),
             )
         except PlannerError as e:
             return _json_error(422, f"planning failed: {e}")
@@ -291,7 +307,9 @@ def build_app(cp: ControlPlane) -> web.Application:
         if not isinstance(payload, dict):
             return _json_error(400, "'payload' must be an object")
         try:
-            out = await cp.plan_and_execute(intent, payload)
+            out = await cp.plan_and_execute(
+                intent, payload, tenant=_tenant_of(request)
+            )
         except PlannerError as e:
             return _json_error(422, f"planning failed: {e}")
         return web.json_response(out)

@@ -106,6 +106,7 @@ class ControlPlane:
         use_cache: bool = True,
         degraded: bool = False,
         deadline_at: Optional[float] = None,
+        tenant: str = "default",
     ) -> tuple[Plan, float]:
         """Plan an intent; returns (plan, latency_ms).
 
@@ -117,7 +118,10 @@ class ControlPlane:
         heuristic plans after the ladder recovers). ``deadline_at`` (the
         scheduler grant's EDF deadline, monotonic) rides the PlanContext to
         the engine so prefix-locality admission never regroups a request
-        whose deadline can't afford it."""
+        whose deadline can't afford it. ``tenant`` (the scheduler grant's
+        tenant, or the tenant header when no scheduler runs) rides the
+        PlanContext to the engine's cache governor so radix-tree KV
+        insertions are charged to the right weighted-fair quota."""
         t0 = time.monotonic()
         with tracing.span(
             "plan", path="degraded" if degraded else "primary"
@@ -158,7 +162,8 @@ class ControlPlane:
                 sp.set(planner=type(planner).__name__)
             with tracing.span("plan.context"):
                 context = await self._context(
-                    intent, version=version, deadline_at=deadline_at
+                    intent, version=version, deadline_at=deadline_at,
+                    tenant=tenant,
                 )
             try:
                 plan = await planner.plan(intent, context)
@@ -205,6 +210,7 @@ class ControlPlane:
         *,
         deadline_at: Optional[float] = None,
         replan_prior: Optional[tuple[str, ...]] = None,
+        tenant: str = "default",
     ) -> PlanContext:
         shortlist = None
         exclude = exclude or set()
@@ -227,6 +233,7 @@ class ControlPlane:
             registry_version=version,
             deadline_at=deadline_at,
             replan_prior=replan_prior,
+            tenant=tenant,
         )
 
     # --------------------------------------------------------------- execute
@@ -246,7 +253,9 @@ class ControlPlane:
         )
 
     # ------------------------------------------------------- plan_and_execute
-    async def plan_and_execute(self, intent: str, payload: dict[str, Any]) -> dict[str, Any]:
+    async def plan_and_execute(
+        self, intent: str, payload: dict[str, Any], *, tenant: str = "default"
+    ) -> dict[str, Any]:
         """Plan, execute, and adaptively replan around observed failures
         (bounded by ``telemetry.max_replans``).
 
@@ -259,7 +268,7 @@ class ControlPlane:
         so the replan decode continues from the cached prefix at
         incremental-decode cost instead of cold re-planning."""
         trace = ExecutionTrace()
-        plan, _ = await self.plan(intent)
+        plan, _ = await self.plan(intent, tenant=tenant)
         engine = getattr(self.planner, "engine", None)
         pin = None
         if engine is not None and plan.prompt_ids:
@@ -285,7 +294,7 @@ class ControlPlane:
                 self.metrics.replans.inc()
                 trace.replans += 1
                 context = await self._context(
-                    intent, exclude, replan_prior=prior or None
+                    intent, exclude, replan_prior=prior or None, tenant=tenant
                 )
                 try:
                     plan = await self.planner.plan(intent, context)

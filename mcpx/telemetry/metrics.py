@@ -205,6 +205,61 @@ class Metrics:
             "under pool pressure or cache budget)",
             registry=self.registry,
         )
+        # Tiered KV cache (mcpx/engine/spill.py, docs/engine.md "Tiered KV
+        # & cache governance"): host-RAM spill tier + per-tenant governance
+        # under the radix tree. All zero while engine.kv_tier is off.
+        self.kv_spills = Counter(
+            "mcpx_kv_spill_spills_total",
+            "Radix-tree KV runs migrated device->host under eviction "
+            "pressure (async gather; the destructive-eviction alternative)",
+            registry=self.registry,
+        )
+        self.kv_readmits = Counter(
+            "mcpx_kv_spill_readmits_total",
+            "Spilled KV runs re-admitted host->device on a prefix match "
+            "(async page copy instead of re-prefilling the run)",
+            registry=self.registry,
+        )
+        self.kv_destructive_evictions = Counter(
+            "mcpx_kv_spill_destructive_evictions_total",
+            "Evictions that DESTROYED KV despite the tier (host/copy "
+            "budget overrun, chaos host-alloc failure, unreachable spilled "
+            "subtree under a dropped parent) — the tier's visible "
+            "degradation path",
+            registry=self.registry,
+        )
+        self.kv_host_evictions = Counter(
+            "mcpx_kv_spill_host_evictions_total",
+            "Spilled runs dropped from the host tier (LRU, under the "
+            "host byte budget)",
+            registry=self.registry,
+        )
+        self.kv_denied_readmits = Counter(
+            "mcpx_kv_spill_denied_readmits_total",
+            "Prefix matches that ended at a spilled run because the "
+            "per-admission-cycle copy budget (or device budget) refused "
+            "the readmit — the request prefilled instead",
+            registry=self.registry,
+        )
+        self.kv_host_tokens = Gauge(
+            "mcpx_kv_spill_host_tokens",
+            "Prompt tokens whose KV is resident in the host spill tier",
+            registry=self.registry,
+        )
+        self.kv_host_bytes = Gauge(
+            "mcpx_kv_spill_host_bytes",
+            "Pinned host bytes held by the spill tier (vs its configured "
+            "budget, engine.kv_tier.host_mb)",
+            registry=self.registry,
+        )
+        self.kv_tenant_resident_tokens = Gauge(
+            "mcpx_kv_tenant_resident_tokens",
+            "Device-resident radix-tree KV tokens per tenant (cache "
+            "governance; tenants past the governor's cardinality cap fold "
+            "into 'other', so the label space is bounded)",
+            ["tenant"],
+            registry=self.registry,
+        )
         # Grammar-aware speculative decoding (engine/speculative.py): how
         # many tokens the recurrent drafter proposed and how many survived
         # the batched verify, split by row class — constrained rows draft

@@ -1,0 +1,52 @@
+"""Negative fixtures: evict-without-refcount-consult stays silent."""
+
+
+class Node:
+    def __init__(self):
+        self.refs = 0
+        self.pages = []
+
+
+class DirectConsult:
+    def __init__(self):
+        self.nodes = {}
+
+    def pin(self, key):
+        self.nodes[key].refs += 1
+
+    def evict(self, need):
+        # consults the refcount inline before any removal
+        for key in list(self.nodes):
+            victim = self.nodes[key]
+            if victim.refs != 0:
+                continue
+            self.nodes.pop(key)
+            need -= 1
+
+
+class HelperConsult:
+    def __init__(self):
+        self.nodes = {}
+
+    def pin(self, key):
+        self.nodes[key].refs += 1
+
+    def _evictable(self, node):
+        return node.refs == 0 and not node.pages
+
+    def evict_lru(self):
+        for key in list(self.nodes):
+            if self._evictable(self.nodes[key]):
+                self.nodes.pop(key)
+
+
+class PlainLru:
+    """No refcounts anywhere: a plain LRU may evict freely (bounding it is
+    unbounded-cache-growth's business, not this rule's)."""
+
+    def __init__(self):
+        self.entries = {}
+
+    def evict(self):
+        while len(self.entries) > 8:
+            self.entries.pop(next(iter(self.entries)))

@@ -1,0 +1,41 @@
+"""Positive fixtures: evict-without-refcount-consult."""
+
+
+class Node:
+    def __init__(self):
+        self.refs = 0  # the class IS refcount-aware: pins exist
+        self.pages = []
+
+
+class TieredCache:
+    def __init__(self):
+        self.nodes = {}
+        self.allocator = object()
+
+    def pin(self, key):
+        self.nodes[key].refs += 1
+
+    def evict(self, need):
+        # removes entries with no refs consult anywhere in scope: a pinned
+        # node's pages go back to the allocator under a live reader
+        for key in list(self.nodes):
+            victim = self.nodes.pop(key)
+            self.allocator.free(victim.pages)
+            if need <= 0:
+                break
+            need -= 1
+
+
+class HostTier:
+    def __init__(self):
+        self.runs = {}
+
+    def adopt(self, node, run):
+        node.refs = 0
+        self.runs[node] = run
+
+    def reclaim_lru(self, n):
+        while n and self.runs:
+            node, _run = next(iter(self.runs.items()))
+            del self.runs[node]
+            n -= 1

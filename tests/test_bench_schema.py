@@ -77,6 +77,7 @@ def _stats(**overrides):
         "mixed": None,
         "spec": None,
         "prefix": None,
+        "tier": None,
         "latency_attribution": None,
         "chaos": None,
         "grammar_fallback": {"shape_only": 0, "keys_free": 0, "typed_off": 0},
@@ -97,6 +98,9 @@ def test_output_schema_carries_roofline_pallas_reason_and_verdict():
         # ISSUE 8: the prefix-reuse phase block and its promoted keys.
         "prefix", "prefill_tokens_per_request", "prefill_reduction",
         "prefix_hit_rate", "replan_p50_cold_ms", "replan_p50_warm_ms",
+        # ISSUE 11: the tiered-KV phase block and its promoted keys.
+        "tier", "tier_token_hit_rate", "tier_hit_ratio",
+        "victim_token_hit_rate", "warm_restart_prefill_ratio",
     ):
         assert key in out, key
     # ISSUE 7 fields: the roofline block…
@@ -117,6 +121,30 @@ def test_output_schema_carries_roofline_pallas_reason_and_verdict():
     assert isinstance(out["regression"], dict)
     assert "verdict" in out["regression"]
     json.dumps(out)  # the one-line artifact must stay JSON-serializable
+
+
+def test_output_promotes_tier_phase_acceptance_keys():
+    """ISSUE 11: when the tiered-KV phase ran, its acceptance numbers are
+    promoted to the top level for TRACKED_METRICS regression tracking."""
+    tier = {
+        "working_set_ratio": 10.0,
+        "tier_token_hit_rate": 0.61,
+        "tier_hit_ratio": 4.2,
+        "victim_token_hit_rate": 0.88,
+        "warm_restart_prefill_ratio": 8.0,
+        "spills": 120,
+        "readmits": 80,
+        "destructive_evictions": 0,
+    }
+    out = bench._output_json(_stats(tier=tier), None, "test")
+    assert out["tier"]["working_set_ratio"] == 10.0
+    assert out["tier_token_hit_rate"] == 0.61
+    assert out["tier_hit_ratio"] == 4.2
+    assert out["victim_token_hit_rate"] == 0.88
+    assert out["warm_restart_prefill_ratio"] == 8.0
+    # Skipped phase: block and promoted keys null, never absent.
+    out = bench._output_json(_stats(), None, "test")
+    assert out["tier"] is None and out["tier_token_hit_rate"] is None
 
 
 def test_output_roofline_never_null_even_without_accounting():

@@ -1,0 +1,656 @@
+"""Tiered KV cache (ISSUE 11): host-RAM spill tier semantics (spill /
+readmit / split / evict vs a flat reference model over seeded streams),
+per-tenant cache governance (adversarial-thrash isolation floor), chaos
+degradation paths, warm-restart snapshot round-trip + corrupt-skip, the
+tier-off byte-identical pass-through, and engine shutdown hardening with
+copies in flight."""
+
+import asyncio
+import json
+import os
+import random
+
+import numpy as np
+import pytest
+
+from mcpx.core.config import MCPXConfig
+from mcpx.engine.cache_governor import CacheGovernor
+from mcpx.engine.kv_cache import PageAllocator
+from mcpx.engine.prefix_cache import RadixPrefixCache
+from mcpx.engine.spill import HostSpillTier, SpillChaos
+
+PAGE = 4
+
+
+class StubDevice:
+    """Numpy stand-in for the engine's device-transfer closures: 'KV' for
+    page p is the constant plane p, so a readmitted run's content is
+    checkable without a model."""
+
+    def __init__(self):
+        self.gathers = 0
+        self.readmits = 0
+        self.readmitted_pages: list[list[int]] = []
+
+    def gather(self, pages):
+        self.gathers += 1
+        k = np.asarray(pages, np.float32).reshape(1, 1, len(pages), 1, 1)
+        return k.copy(), -k.copy()
+
+    def readmit(self, k_host, v_host, pages):
+        self.readmits += 1
+        self.readmitted_pages.append(list(pages))
+
+
+def make_tiered(
+    n_pages=64,
+    max_nodes=64,
+    max_tokens=0,
+    *,
+    host_bytes=1 << 20,
+    copy_tokens=0,
+    chaos=None,
+    governor=None,
+    clock=None,
+):
+    alloc = PageAllocator(n_pages=n_pages, page_size=PAGE, max_pages_per_seq=32)
+    kwargs = {"chaos": chaos}
+    if clock is not None:
+        kwargs["clock"] = clock
+    tier = HostSpillTier(
+        host_bytes=host_bytes, copy_tokens_per_cycle=copy_tokens, **kwargs
+    )
+    dev = StubDevice()
+    tier.bind(dev.gather, dev.readmit, bytes_per_token=4)
+    cache = RadixPrefixCache(
+        alloc, PAGE, max_nodes=max_nodes, max_tokens=max_tokens,
+        spill=tier, governor=governor,
+    )
+    return alloc, cache, tier, dev
+
+
+def blocks(*ids):
+    out = []
+    for k in ids:
+        out.extend([k * 100, k * 100 + 1, k * 100 + 2, k * 100 + 3])
+    return out
+
+
+def insert_all(cache, ids, tenant="default"):
+    n, _pages, node = cache.match(ids)
+    want = (len(ids) // PAGE) * PAGE - n
+    inode = None
+    if want > 0:
+        inode = cache.insert(ids, n, want, tenant=tenant)
+        if inode is not None:
+            inode.refs -= 1
+    cache.seal()
+    return n, node, inode
+
+
+# ------------------------------------------------------------- spill basics
+def test_spill_then_readmit_round_trip():
+    alloc, cache, tier, dev = make_tiered(max_tokens=8)
+    a = blocks(1, 2) + [7]  # 8 aligned tokens: exactly the device budget
+    insert_all(cache, a)
+    b = blocks(3, 4) + [7]
+    insert_all(cache, b)  # budget pressure spills a's run
+    tier.poll()
+    assert tier.spills >= 1 and tier.host_tokens >= 8
+    cache.check_invariants()
+    alloc.check_invariants()
+    # Matching a again re-admits its run (and the pressure spills b).
+    n, pages, node = cache.match(a)
+    assert n == 8 and len(pages) == 2
+    assert tier.readmits >= 1
+    assert dev.readmitted_pages[-1] == node.pages[-2:] or dev.readmits >= 1
+    cache.check_invariants()
+    alloc.check_invariants()
+
+
+def test_spilled_partial_match_splits_host_run():
+    _alloc, cache, tier, _dev = make_tiered(max_tokens=12)
+    a = blocks(1, 2, 3) + [9]
+    insert_all(cache, a)
+    insert_all(cache, blocks(5, 6, 7) + [9])  # spills a (12 tokens)
+    tier.poll()
+    assert cache.n_spilled >= 1
+    # A prompt sharing only a's first block: the HOST run must split at
+    # the page boundary and readmit just the head.
+    b = blocks(1, 8) + [9]
+    n, pages, node = cache.match(b)
+    assert n == 4 and len(pages) == 1
+    assert node is not None and len(node.tokens) == 4 and node.pages
+    cache.check_invariants()
+
+
+def test_property_tiered_matches_flat_reference():
+    """Seeded insert/match streams under constant device-budget pressure:
+    with an unbounded host tier nothing is ever destroyed, so every match
+    must equal the flat-reference longest common page-aligned prefix —
+    the cliff the single-tier cache falls off (destroyed subtrees) is
+    structurally gone."""
+    rng = random.Random(77)
+    _alloc, cache, tier, _dev = make_tiered(
+        n_pages=256, max_nodes=256, max_tokens=48
+    )
+    inserted: list[list[int]] = []
+
+    def expected(ids):
+        best = 0
+        for ref in inserted:
+            d = 0
+            while d < min(len(ref), len(ids)) and ref[d] == ids[d]:
+                d += 1
+            best = max(best, (d // PAGE) * PAGE)
+        return min(best, cache.match_cap(len(ids)))
+
+    for step in range(120):
+        seq = blocks(*(rng.randrange(12) for _ in range(rng.randrange(1, 6))))
+        seq.append(7)  # suffix token past the aligned head
+        # The engine worker polls every iteration; mirror that here (an
+        # unpolled in-flight spill is legitimately unmatchable).
+        tier.poll()
+        n, pages, _node = cache.match(seq)
+        assert n == expected(seq), (step, n, expected(seq))
+        assert len(pages) == n // PAGE
+        want = (len(seq) // PAGE) * PAGE - n
+        if want > 0:
+            inode = cache.insert(seq, n, want)
+            if inode is not None:
+                inode.refs -= 1
+        cache.seal()
+        # The tree caches page-aligned heads whole, so the reference set
+        # only grows when the insert succeeded (collisions are refused).
+        if want <= 0 or inode is not None:
+            inserted.append(seq)
+        cache.check_invariants()
+        _alloc.check_invariants()
+    tier.poll()
+    assert tier.spills > 0 and tier.readmits > 0  # the stream exercised both
+    assert tier.destructive_evictions == 0  # unbounded host: nothing lost
+
+
+# ------------------------------------------------------------------ budgets
+def test_copy_budget_denies_readmit_and_counts():
+    _alloc, cache, tier, _dev = make_tiered(max_tokens=8, copy_tokens=0)
+    a = blocks(1, 2) + [7]
+    insert_all(cache, a)
+    insert_all(cache, blocks(3, 4) + [7])
+    tier.poll()
+    tier.copy_tokens_per_cycle = 1  # below any run length
+    tier.begin_cycle()
+    n, pages, _ = cache.match(a)
+    assert n == 0 and not pages  # match ends at the spilled run
+    assert tier.denied_readmits >= 1
+    tier.copy_tokens_per_cycle = 0  # unlimited again
+    tier.begin_cycle()
+    assert cache.match(a)[0] == 8  # same data, now admitted
+    cache.check_invariants()
+
+
+def test_host_budget_overrun_degrades_to_destructive_eviction():
+    _alloc, cache, tier, _dev = make_tiered(max_tokens=8, host_bytes=40)
+    # Each 8-token run estimates 32 host bytes: one fits, the second must
+    # first LRU-drop the spilled one; a zero-budget tier destroys instead.
+    insert_all(cache, blocks(1, 2) + [7])
+    insert_all(cache, blocks(3, 4) + [7])
+    tier.poll()
+    insert_all(cache, blocks(5, 6) + [7])
+    tier.poll()
+    assert tier.spills >= 1
+    assert tier.host_evictions >= 1 or tier.destructive_evictions >= 1
+    cache.check_invariants()
+    tier2_alloc, cache2, tier2, _ = make_tiered(max_tokens=8, host_bytes=0)
+    insert_all(cache2, blocks(1, 2) + [7])
+    insert_all(cache2, blocks(3, 4) + [7])
+    assert tier2.destructive_evictions >= 1 and tier2.host_tokens == 0
+    cache2.check_invariants()
+
+
+def test_evict_consults_refcount_even_tiered():
+    """A pinned run survives full eviction pressure in BOTH tiers (the
+    evict-without-refcount-consult contract, exercised live)."""
+    _alloc, cache, tier, _dev = make_tiered(max_tokens=64)
+    a = blocks(1, 2) + [7]
+    insert_all(cache, a)
+    n, _pages, node = cache.match(a)
+    assert n == 8 and node is not None
+    node.refs += 1  # live reader pin
+    cache.max_tokens = 0
+    cache.evict()
+    assert node.pages and node.host is None  # untouched: pinned
+    node.refs -= 1
+    cache.evict()
+    tier.poll()
+    assert node.host is not None or node.parent is None  # reclaimed now
+    cache.check_invariants()
+
+
+# -------------------------------------------------------------------- chaos
+def test_chaos_host_alloc_failure_counts_destructive():
+    chaos = SpillChaos({"seed": 3, "host_alloc_fail_p": 1.0})
+    _alloc, cache, tier, _dev = make_tiered(max_tokens=8, chaos=chaos)
+    insert_all(cache, blocks(1, 2) + [7])
+    insert_all(cache, blocks(3, 4) + [7])
+    assert tier.chaos_alloc_failures >= 1
+    assert tier.destructive_evictions >= 1
+    assert tier.host_tokens == 0
+    cache.check_invariants()
+
+
+def test_chaos_copy_latency_delays_readmit():
+    t = {"now": 100.0}
+    clock = lambda: t["now"]  # noqa: E731
+    chaos = SpillChaos(
+        {"seed": 3, "copy_delay_p": 1.0, "copy_delay_s": 5.0}, clock=clock
+    )
+    _alloc, cache, tier, _dev = make_tiered(
+        max_tokens=8, chaos=chaos, clock=clock
+    )
+    a = blocks(1, 2) + [7]
+    insert_all(cache, a)
+    insert_all(cache, blocks(3, 4) + [7])
+    tier.poll()  # fetch lands, but the chaos spike delays usability
+    assert tier.host_tokens >= 8
+    assert cache.match(a)[0] == 0  # not usable yet
+    t["now"] += 6.0
+    assert cache.match(a)[0] == 8  # spike over: readmit serves
+    assert tier.readmits >= 1
+
+
+def test_chaos_profile_validation_and_reseed():
+    with pytest.raises(ValueError):
+        SpillChaos({"host_alloc_fail_p": 1.5})
+    c = SpillChaos({"seed": 9, "host_alloc_fail_p": 0.5})
+    seq1 = [c.host_alloc_fails() for _ in range(16)]
+    c.reseed()
+    assert [c.host_alloc_fails() for _ in range(16)] == seq1
+
+
+# --------------------------------------------------------------- governance
+def test_governor_fair_share_and_fold():
+    gov = CacheGovernor({"gold": 3.0}, max_tenants=2)
+    gov.on_insert("gold", 30)
+    gov.on_insert("t1", 10)
+    # gold holds 3/4 of the budget by weight.
+    assert gov.fair_share_tokens("gold", 400) == 300
+    assert gov.fair_share_tokens("t1", 400) == 100
+    assert not gov.over_share("gold", 400)
+    assert gov.over_share("t1", 400, extra=95)
+    # Cardinality cap: tenant #3 folds into "other".
+    gov.on_insert("t2", 5)
+    assert gov.fold("t2") == "other"
+    assert gov.device_tokens("t2") == 5  # accounted under the fold
+    stats = gov.stats(400)
+    assert set(stats) == {"gold", "t1", "other"}
+
+
+def test_adversarial_thrash_tenant_cannot_flush_victim():
+    """The isolation acceptance: a tenant streaming unique prompts at
+    volume displaces only its own share — the victim tenant's repeated
+    set stays resident and its token hit rate keeps a floor."""
+    gov = CacheGovernor()
+    _alloc, cache, tier, _dev = make_tiered(
+        n_pages=256, max_nodes=256, max_tokens=64, governor=gov,
+        host_bytes=0,  # worst case: no host tier to hide behind
+    )
+    victim_set = [blocks(1, i) + [7] for i in range(2, 6)]  # 32 tokens
+    for seq in victim_set:
+        insert_all(cache, seq, tenant="victim")
+    for i in range(60):
+        # thrash: unique 16-token prompts, never repeated
+        seq = blocks(50 + i, 50 + i, 50 + i, 50 + i) + [7]
+        insert_all(cache, seq, tenant="thrash")
+        for vseq in victim_set:
+            n, _p, node = cache.match(vseq, record=False)
+            if node is not None:
+                gov.on_lookup("victim", n, len(vseq) - n)
+        cache.check_invariants()
+    # The victim's radix-deduped working set (shared first block + four
+    # 1-block tails = 20 tokens) sits under its fair half of 64: residency
+    # held, every repeat still fully matched, hit rate near-perfect
+    # despite 60x thrash volume.
+    assert gov.device_tokens("victim") >= 20
+    for vseq in victim_set:
+        assert cache.match(vseq, record=False)[0] == 8
+    assert gov.token_hit_rate("victim") > 0.8
+    # Contrast: without a governor the same stream flushes the victim.
+    _alloc2, cache2, _tier2, _dev2 = make_tiered(
+        n_pages=256, max_nodes=256, max_tokens=64, host_bytes=0
+    )
+    for seq in victim_set:
+        insert_all(cache2, seq)
+    for i in range(60):
+        insert_all(cache2, blocks(50 + i, 50 + i, 50 + i, 50 + i) + [7])
+    flushed = sum(
+        1 for vseq in victim_set if cache2.match(vseq, record=False)[0] == 0
+    )
+    assert flushed >= 2  # LRU alone lets the thrash displace the victim
+
+
+def test_over_quota_tenant_reclaims_its_own_first():
+    gov = CacheGovernor()
+    _alloc, cache, tier, _dev = make_tiered(
+        n_pages=256, max_nodes=256, max_tokens=32, governor=gov
+    )
+    insert_all(cache, blocks(1, 2) + [9], tenant="a")  # 8 tokens
+    for i in range(4):  # b floods past its 24-token share (of 32, 2 tenants -> 16)
+        insert_all(cache, blocks(10 + i, 20 + i) + [9], tenant="b")
+        cache.check_invariants()
+    tier.poll()
+    # a's residency is untouched; b spilled/evicted its own.
+    assert gov.device_tokens("a") == 8
+    assert gov.device_tokens("b") <= gov.fair_share_tokens("b", 32)
+
+
+def test_governor_snapshot_round_trip():
+    gov = CacheGovernor({"gold": 2.5})
+    state = gov.snapshot()
+    gov2 = CacheGovernor()
+    gov2.restore(state)
+    assert gov2.weight("gold") == 2.5
+    gov2.restore({"weights": {"bad": "x", "neg": -1, "ok": 4}})
+    assert gov2.weight("ok") == 4.0 and gov2.weight("neg") == 1.0
+
+
+# ------------------------------------------------------- shutdown hardening
+def test_tier_reset_with_copies_in_flight_drops_cleanly():
+    class NeverReady:
+        def __init__(self, arr):
+            self._arr = arr
+
+        def is_ready(self):
+            return False
+
+        def __array__(self, dtype=None):
+            return self._arr
+
+    tier = HostSpillTier(host_bytes=1 << 20)
+    holder = []
+
+    def gather(pages):
+        a = np.zeros((1, 1, len(pages), 1, 1), np.float32)
+        h = (NeverReady(a), NeverReady(a))
+        holder.append(h)
+        return h
+
+    tier.bind(gather, lambda *a: None, bytes_per_token=4)
+
+    class FakeNode:
+        tokens = tuple(range(8))
+        tenant = "default"
+        host = None
+
+    node = FakeNode()
+    assert tier.spill(node, [1, 2])
+    tier.poll()  # not ready: stays pending
+    assert tier.pending_copies() == 1
+    tier.reset()  # shutdown path: drop handles + accounting, no join
+    assert tier.pending_copies() == 0
+    assert tier.host_tokens == 0 and tier.host_bytes_used == 0
+    assert node.host is None
+    # drain() on a fresh spill completes synchronously instead.
+    node2 = FakeNode()
+    assert tier.spill(node2, [3, 4])
+    tier.drain()
+    assert node2.host is not None and node2.host.ready
+
+
+# ----------------------------------------------------------- engine-level
+def _engine_cfg(tier=True, snap="", chaos="", host_mb=64.0):
+    return MCPXConfig.from_dict(
+        {
+            "model": {"size": "test"},
+            "engine": {
+                "max_batch_size": 4,
+                "max_pages_per_seq": 16,
+                "kv_page_size": 16,
+                "max_decode_len": 16,
+                "prefix_cache_entries": 64,
+                "kv_tier": {
+                    "enabled": tier,
+                    "host_mb": host_mb,
+                    "snapshot_path": snap,
+                    "chaos_profile": chaos,
+                },
+            },
+        }
+    )
+
+
+def _prompts(tok, tag, n, body="wxyz "):
+    return [
+        tok.encode(f"{tag} probe {i}: " + body * 28)[:128] for i in range(n)
+    ]
+
+
+def test_engine_spill_readmit_outputs_byte_identical():
+    """THE correctness gate: generations served from re-admitted
+    (spilled → host → copied-back) KV are byte-identical to a fresh
+    engine's — the copies preserve attention exactly."""
+
+    async def go():
+        from mcpx.engine.engine import InferenceEngine
+
+        eng = InferenceEngine(_engine_cfg(True))
+        ref = InferenceEngine(_engine_cfg(False))
+        await eng.start()
+        await ref.start()
+        try:
+            tok = eng.tokenizer
+            prompts = _prompts(tok, "parity", 8)
+            outs = {}
+            for rnd in range(2):
+                for i, p in enumerate(prompts):
+                    r = await eng.generate(
+                        p, max_new_tokens=8, constrained=False, temperature=0.0
+                    )
+                    outs[(rnd, i)] = r.token_ids
+            tier = eng.prefix_cache_stats()["tier"]
+            assert tier["spills"] > 0 and tier["readmits"] > 0
+            assert tier["enabled"] is True
+            for i, p in enumerate(prompts):
+                r = await ref.generate(
+                    p, max_new_tokens=8, constrained=False, temperature=0.0
+                )
+                for rnd in range(2):
+                    assert outs[(rnd, i)] == r.token_ids, (rnd, i)
+            # tier-off pass-through: no tier/governor blocks, no spill state.
+            off = ref.prefix_cache_stats()
+            assert off["tier"] is None and off["governor"] is None
+            assert ref._spill_tier is None and ref._governor is None
+            eng._prefix_cache.check_invariants()
+            eng._allocator.check_invariants()
+        finally:
+            await eng.aclose()
+            await ref.aclose()
+
+    asyncio.run(go())
+
+
+def test_engine_snapshot_round_trip_and_corrupt_skip(tmp_path):
+    """Warm-restart acceptance: a clean aclose snapshots the resident
+    heads; the restarted engine serves the first plan from re-admitted KV
+    (prefill tokens a fraction of cold), byte-identical output; a corrupt
+    snapshot is skipped, never fatal."""
+
+    async def go():
+        from mcpx.engine.engine import InferenceEngine
+
+        snap = str(tmp_path / "kv.snap")
+
+        def prefill_total(e):
+            for line in e.metrics.render().decode().splitlines():
+                if line.startswith("mcpx_engine_prefill_tokens_total "):
+                    return float(line.split()[-1])
+            return 0.0
+
+        eng = InferenceEngine(_engine_cfg(True, snap=snap))
+        await eng.start()
+        tok = eng.tokenizer
+        prompts = _prompts(tok, "warm", 3, body="qrst ")
+        outs = []
+        for p in prompts:
+            r = await eng.generate(
+                p, max_new_tokens=8, constrained=False, temperature=0.0
+            )
+            outs.append(r.token_ids)
+        await eng.aclose()
+        assert os.path.exists(snap) and os.path.exists(snap + ".npz")
+        manifest = json.load(open(snap))
+        assert manifest["version"] == 1 and manifest["nodes"]
+
+        # Restart: heads restore as host-tier residents (zero prefill).
+        eng2 = InferenceEngine(_engine_cfg(True, snap=snap))
+        await eng2.start()
+        st = eng2.prefix_cache_stats()
+        assert st["spilled_nodes"] >= 3 and st["host_tokens"] >= 3 * 112
+        pf0 = prefill_total(eng2)
+        r = await eng2.generate(
+            prompts[0], max_new_tokens=8, constrained=False, temperature=0.0
+        )
+        warm_prefill = prefill_total(eng2) - pf0
+        assert r.token_ids == outs[0]  # snapshot KV attends identically
+        # Cold would prefill the whole 128-token prompt; warm re-admits
+        # the 112-token head and prefills only the last page.
+        assert warm_prefill <= 64, warm_prefill
+        assert eng2.prefix_cache_stats()["tier"]["readmits"] >= 1
+        await eng2.aclose()
+
+        # Corrupt snapshot: detected, skipped, engine serves cold.
+        with open(snap, "w") as f:
+            f.write('{"version": 1, "garbage')
+        eng3 = InferenceEngine(_engine_cfg(True, snap=snap))
+        await eng3.start()
+        assert eng3.state == "ready"
+        assert eng3.prefix_cache_stats()["spilled_nodes"] == 0
+        r3 = await eng3.generate(
+            prompts[0], max_new_tokens=8, constrained=False, temperature=0.0
+        )
+        assert r3.token_ids == outs[0]
+        await eng3.aclose()
+
+        # Stale snapshot (page geometry changed): skipped too.
+        manifest["page_size"] = 999
+        with open(snap, "w") as f:
+            json.dump(manifest, f)
+        eng4 = InferenceEngine(_engine_cfg(True, snap=snap))
+        await eng4.start()
+        assert eng4.prefix_cache_stats()["spilled_nodes"] == 0
+        await eng4.aclose()
+
+    asyncio.run(go())
+
+
+def test_engine_snapshot_ids_only_fallback_rebuilds_lazily(tmp_path):
+    """When the snapshot's KV is unusable (params fingerprint changed —
+    e.g. a checkpoint swap) the declared heads restore as ids only and
+    re-prefill LAZILY on their first matching use; stale KV is never
+    attended."""
+
+    async def go():
+        from mcpx.engine.engine import InferenceEngine
+
+        snap = str(tmp_path / "kv.snap")
+        eng = InferenceEngine(_engine_cfg(True, snap=snap))
+        await eng.start()
+        tok = eng.tokenizer
+        p = _prompts(tok, "lazy", 1, body="dfgh ")[0]
+        r0 = await eng.generate(
+            p, max_new_tokens=8, constrained=False, temperature=0.0,
+            shared_prefix_len=80,
+        )
+        await eng.aclose()
+        manifest = json.load(open(snap))
+        assert manifest["declared_heads"], "declared head not recorded"
+        manifest["fingerprint"] = 1e9  # a different model's KV
+        with open(snap, "w") as f:
+            json.dump(manifest, f)
+
+        eng2 = InferenceEngine(_engine_cfg(True, snap=snap))
+        await eng2.start()
+        st = eng2.prefix_cache_stats()
+        assert st["spilled_nodes"] == 0  # stale KV refused
+        assert eng2._warm_heads, "ids-only heads not queued"
+        r1 = await eng2.generate(
+            p, max_new_tokens=8, constrained=False, temperature=0.0,
+            shared_prefix_len=80,
+        )
+        assert r1.token_ids == r0.token_ids
+        assert not eng2._warm_heads  # consumed by its lazy rebuild
+        assert eng2.prefix_cache_stats()["resident_tokens"] > 0
+        await eng2.aclose()
+
+    asyncio.run(go())
+
+
+def test_engine_aclose_with_spills_in_flight_is_clean(tmp_path):
+    """Shutdown hardening: aclose() racing freshly-dispatched spill
+    copies joins/drops them cleanly — no orphaned host accounting, no
+    dangling device handles, snapshot still written."""
+
+    async def go():
+        from mcpx.engine.engine import InferenceEngine
+
+        snap = str(tmp_path / "kv.snap")
+        eng = InferenceEngine(_engine_cfg(True, snap=snap))
+        await eng.start()
+        tok = eng.tokenizer
+        for i, p in enumerate(_prompts(tok, "close", 6, body="lmno ")):
+            await eng.generate(
+                p, max_new_tokens=2, constrained=False, temperature=0.0
+            )
+        # Close immediately: spill gathers from the last admissions may
+        # still be pending in the tier.
+        await eng.aclose()
+        assert eng.state == "closed"
+        tier = eng._spill_tier
+        assert tier.pending_copies() == 0
+        assert tier.host_tokens == 0 and tier.host_bytes_used == 0
+        assert os.path.exists(snap)  # clean close still snapshotted
+
+    asyncio.run(go())
+
+
+def test_chaos_profile_inline_config_reaches_tier():
+    from mcpx.engine.engine import InferenceEngine
+
+    cfg = _engine_cfg(True, chaos='{"seed": 5, "host_alloc_fail_p": 0.25}')
+    eng = InferenceEngine(cfg)
+    assert eng._spill_tier.chaos is not None
+    assert eng._spill_tier.chaos.host_alloc_fail_p == 0.25
+
+
+def test_kv_tier_config_validation():
+    with pytest.raises(Exception):
+        MCPXConfig.from_dict(
+            {"engine": {"kv_tier": {"enabled": False, "snapshot_path": "/x"}}}
+        )
+    with pytest.raises(Exception):
+        MCPXConfig.from_dict(
+            {"engine": {"kv_tier": {"enabled": True, "host_mb": -1}}}
+        )
+    with pytest.raises(Exception):
+        MCPXConfig.from_dict(
+            {
+                "engine": {
+                    "kv_tier": {
+                        "enabled": True,
+                        "tenant_weights": {"t": -2.0},
+                    }
+                }
+            }
+        )
+    cfg = MCPXConfig.from_dict(
+        {
+            "engine": {
+                "kv_tier": {
+                    "enabled": True,
+                    "tenant_weights": {"gold": 4.0},
+                    "copy_tokens_per_cycle": 0,
+                }
+            }
+        }
+    )
+    assert cfg.engine.kv_tier.tenant_weights == {"gold": 4.0}

@@ -55,6 +55,20 @@ def test_unknown_rule_id_rejected():
 
 
 # ------------------------------------------------------------------ fixtures
+def test_evict_without_refcount_positive():
+    # An inline pop-and-free evict and a helper-class host-tier reclaim,
+    # both in refcount-aware classes, neither consulting refs.
+    assert hits(
+        "evict_refcount_pos.py", "evict-without-refcount-consult"
+    ) == [23, 40]
+
+
+def test_evict_without_refcount_negative():
+    # Inline refs consult, one-hop same-class helper consult, and a plain
+    # refcount-free LRU all stay silent.
+    assert hits("evict_refcount_neg.py", "evict-without-refcount-consult") == []
+
+
 def test_async_blocking_positive():
     assert hits("async_blocking_pos.py", "async-blocking") == [10, 14, 19, 23, 27]
 

@@ -343,6 +343,13 @@ def test_engine_queue_stats_surface():
         "prefix_resident_pages": 0,
         "prefix_hit_rate": 0.0,
         "prefix_token_hit_rate": 0.0,
+        # Tiered-KV additions (ISSUE 11): host-tier residency and the
+        # spill/readmit/destructive tallies — zeros single-tier and on a
+        # cold tiered engine alike.
+        "prefix_host_pages": 0,
+        "prefix_spills": 0,
+        "prefix_readmits": 0,
+        "prefix_destructive_evictions": 0,
         "depth": 0,
         "active": 0,
         "service_ewma_s": 0.0,

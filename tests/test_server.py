@@ -203,6 +203,43 @@ def test_cache_endpoint_combines_plan_and_prefix_stats():
     asyncio.run(go())
 
 
+def test_cache_endpoint_surfaces_tier_and_governor_stats():
+    """GET /cache (ISSUE 11 satellite): with the tiered KV cache armed the
+    prefix block carries the host-tier accounting (resident host tokens/
+    bytes, spills/readmits/destructive evictions) and the per-tenant
+    governor spread; single-tier engines report both as null (the
+    pass-through contract)."""
+    from mcpx.core.config import MCPXConfig
+    from mcpx.engine.engine import InferenceEngine
+
+    eng = InferenceEngine(
+        MCPXConfig.from_dict(
+            {
+                "model": {"size": "test"},
+                "engine": {"kv_tier": {"enabled": True, "host_mb": 8.0}},
+            }
+        )
+    )
+    st = eng.prefix_cache_stats()
+    tier = st["tier"]
+    assert tier["enabled"] is True
+    for key in (
+        "host_tokens", "host_bytes", "host_bytes_budget", "spills",
+        "readmits", "destructive_evictions", "denied_readmits",
+    ):
+        assert key in tier, key
+    assert st["governor"] == {}  # no tenants observed yet
+    assert "spilled_nodes" in st and "host_pages" in st
+    # queue_stats prefix scoreboard extension rides the same counters.
+    eng._governor.on_insert("gold", 32)
+    assert eng.prefix_cache_stats()["governor"]["gold"]["resident_tokens"] == 32
+    off = InferenceEngine(
+        MCPXConfig.from_dict({"model": {"size": "test"}})
+    )
+    st_off = off.prefix_cache_stats()
+    assert st_off["tier"] is None and st_off["governor"] is None
+
+
 def test_missing_registration_returns_400():
     async def go():
         cp, app = make_app()
