@@ -5,6 +5,7 @@ new rule — see docs/static-analysis.md."""
 from mcpx.analysis.rules import (  # noqa: F401
     async_rules,
     cache_rules,
+    io_rules,
     jax_rules,
     jit_contract_rules,
     metrics_rules,

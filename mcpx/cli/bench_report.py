@@ -56,6 +56,8 @@ TRACKED_METRICS: tuple[tuple[str, str, Optional[str]], ...] = (
     ("prefill_tokens_per_request", "lower", None),
     ("prefix_hit_rate", "higher", None),
     ("replan_p50_warm_ms", "lower", None),
+    ("replan_warm_sat_p50_ms", "lower", None),
+    ("flight_overhead_frac", "lower", None),
     ("tier_token_hit_rate", "higher", None),
     ("tier_hit_ratio", "higher", None),
     ("victim_token_hit_rate", "higher", None),

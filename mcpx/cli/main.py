@@ -113,6 +113,55 @@ def cmd_trace(args: argparse.Namespace) -> int:
         return 1
 
 
+def cmd_debug(args: argparse.Namespace) -> int:
+    """Flight recorder tooling (mcpx/telemetry/flight.py,
+    docs/observability.md). ``bundle`` fetches one diagnostic bundle from
+    a running server — ``--id``, or the newest captured — validates its
+    schema, and writes it to a local file; the round trip the acceptance
+    tests gate on."""
+    from mcpx.telemetry.flight import _bundle_trace_ids, validate_bundle
+
+    base = args.url.rstrip("/")
+    try:
+        status = _http_json(f"{base}/debug/anomalies")
+        if args.action == "list":
+            print(json.dumps(status, indent=2))
+            return 0
+        # bundle: explicit --id, else the newest captured bundle.
+        if not status.get("enabled"):
+            print(json.dumps({"error": "flight recorder disabled on the server"}))
+            return 1
+        bundle_id = args.id
+        if not bundle_id:
+            bundles = status.get("bundles", [])
+            if not bundles:
+                print(json.dumps({"error": "no bundles captured on the server"}))
+                return 1
+            bundle_id = bundles[-1]["bundle_id"]
+        bundle = _http_json(f"{base}/debug/anomalies/{bundle_id}")
+        problems = validate_bundle(bundle)
+        out_path = args.out or f"bundle_{bundle_id}.json"
+        with open(out_path, "w") as f:
+            json.dump(bundle, f, indent=2)
+        print(
+            json.dumps(
+                {
+                    "bundle_id": bundle_id,
+                    "wrote": out_path,
+                    "valid": not problems,
+                    **({"problems": problems} if problems else {}),
+                    "trigger": bundle.get("trigger"),
+                    "window_snapshots": len(bundle.get("window") or []),
+                    "trace_ids": _bundle_trace_ids(bundle)[:8],
+                }
+            )
+        )
+        return 0 if not problems else 1
+    except RuntimeError as e:
+        print(json.dumps({"error": str(e)}))
+        return 1
+
+
 def cmd_validate(args: argparse.Namespace) -> int:
     """Validate a plan JSON file against the DAG schema."""
     from mcpx.core.dag import Plan, PlanValidationError
@@ -301,6 +350,25 @@ def main(argv: list[str] | None = None) -> int:
         help="output path for dump (default: trace_<id>.json)",
     )
     p_trace.set_defaults(func=cmd_trace)
+
+    p_debug = sub.add_parser(
+        "debug",
+        help="flight-recorder tooling: list detector state, fetch anomaly bundles",
+    )
+    p_debug.add_argument("action", choices=["list", "bundle"])
+    p_debug.add_argument(
+        "--url", default="http://127.0.0.1:8000",
+        help="server base URL (default: %(default)s)",
+    )
+    p_debug.add_argument(
+        "--id", default="",
+        help="bundle id to fetch (default: the newest captured bundle)",
+    )
+    p_debug.add_argument(
+        "--out", default="",
+        help="output path for bundle (default: bundle_<id>.json)",
+    )
+    p_debug.set_defaults(func=cmd_debug)
 
     p_val = sub.add_parser("validate", help="validate a plan JSON file")
     p_val.add_argument("file", help="path or - for stdin")

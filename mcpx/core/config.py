@@ -307,6 +307,60 @@ class RetrievalConfig:
 
 
 @dataclass
+class FlightConfig:
+    """Flight recorder & anomaly observatory (mcpx/telemetry/flight.py,
+    docs/observability.md "Flight recorder & anomaly bundles"): an
+    always-on bounded ring of periodic signal snapshots (queue depth,
+    accept rates, prefix/tier scoreboards, compile counters, breaker
+    states, shed rates, streaming latency quantiles) with SPC-style
+    EWMA+MAD anomaly detectors that, on trip, capture a versioned
+    diagnostic bundle (tail-sampled traces, /costs snapshot, the flight
+    window around the trigger, breaker/governor state, recent log tail)
+    written atomically OFF the event loop and served via
+    ``GET /debug/anomalies`` + ``mcpx debug bundle``. Off by default:
+    with ``enabled=false`` no sampling task runs, no detector state
+    exists, and the serving path is byte-identical (parity-tested)."""
+
+    enabled: bool = False
+    # Snapshot period of the recorder's sampling loop.
+    interval_s: float = 1.0
+    # Snapshots retained in the in-memory flight ring (oldest evicted):
+    # 512 x 1 s ~ 8.5 minutes of history around any trigger.
+    ring_size: int = 512
+    # Decode-loop host profiler (engine worker thread): per-iteration
+    # phase timers — admit / locality-sort / prefix-match / dispatch /
+    # poll / harvest / spill-copy drain / host-bookkeeping / idle —
+    # aggregated into streaming histograms and surfaced in
+    # ``queue_stats()["worker_profile"]``, engine.decode span attrs, the
+    # bench ``worker_profile`` block and the flight ring. Off = the
+    # worker loop takes no clock reads at all (pass-through).
+    profile_worker: bool = False
+    # Run the SPC detectors over the sampled series (enabled only).
+    detectors: bool = True
+    # EWMA smoothing for each signal's running mean and mean-absolute-
+    # deviation (the MAD-style band scale).
+    ewma_alpha: float = 0.3
+    # Band half-width in deviations: a sample outside mean +/- k*MAD (in
+    # the detector's alarm direction) counts as out-of-band.
+    band_k: float = 5.0
+    # Samples a detector must see before it arms (baseline warmup).
+    min_samples: int = 10
+    # Consecutive out-of-band samples required to trip, and consecutive
+    # in-band samples required to re-arm after an excursion ends — one
+    # noisy sample neither trips nor resets an active anomaly.
+    hysteresis: int = 3
+    # Minimum seconds between bundle captures per detector; trips inside
+    # the window are counted (suppressed_trips) but capture no bundle.
+    cooldown_s: float = 30.0
+    # Where diagnostic bundles are written (atomic tmp+rename, off-loop).
+    bundle_dir: str = "/tmp/mcpx-bundles"
+    # Newest bundles kept on disk; older ones pruned at each write.
+    max_bundles: int = 8
+    # Log lines retained in the recorder's in-memory tail (bundled).
+    log_tail: int = 200
+
+
+@dataclass
 class TelemetryConfig:
     enabled: bool = True
     # EWMA smoothing for per-service latency/error-rate.
@@ -326,6 +380,9 @@ class TelemetryConfig:
     # Off = the jitted callables are served unwrapped (byte-identical
     # pass-through; no sentinel, no /costs executable data).
     cost_accounting: bool = True
+    # Flight recorder + anomaly detectors + worker-loop profiler
+    # (mcpx/telemetry/flight.py; see FlightConfig).
+    flight: FlightConfig = field(default_factory=FlightConfig)
     # Replan when a node's observed error-rate breaches this threshold.
     replan_error_rate: float = 0.5
     # or when latency exceeds this multiple of the registry's cost profile.
@@ -669,6 +726,28 @@ class MCPXConfig:
             problems.append("engine.decode_steps_per_tick must be >= 1")
         if not 0.0 < self.telemetry.ewma_alpha <= 1.0:
             problems.append("telemetry.ewma_alpha must be in (0, 1]")
+        fl = self.telemetry.flight
+        if fl.interval_s <= 0:
+            problems.append("telemetry.flight.interval_s must be > 0")
+        if fl.ring_size < 8:
+            problems.append("telemetry.flight.ring_size must be >= 8")
+        if not 0.0 < fl.ewma_alpha <= 1.0:
+            problems.append("telemetry.flight.ewma_alpha must be in (0, 1]")
+        if fl.band_k <= 0:
+            problems.append("telemetry.flight.band_k must be > 0")
+        if fl.min_samples < 2:
+            problems.append("telemetry.flight.min_samples must be >= 2")
+        if fl.hysteresis < 1:
+            problems.append("telemetry.flight.hysteresis must be >= 1")
+        if fl.cooldown_s < 0:
+            problems.append("telemetry.flight.cooldown_s must be >= 0")
+        if fl.max_bundles < 1:
+            problems.append("telemetry.flight.max_bundles must be >= 1")
+        if fl.enabled and not fl.bundle_dir:
+            problems.append(
+                "telemetry.flight.bundle_dir must be set while the "
+                "recorder is enabled (bundles need somewhere to land)"
+            )
         if self.retrieval.top_k < 1:
             problems.append("retrieval.top_k must be >= 1")
         kt = self.engine.kv_tier

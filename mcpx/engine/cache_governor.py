@@ -126,23 +126,33 @@ class CacheGovernor:
         acct["prefilled"] += prefilled
 
     # -------------------------------------------------------------- quotas
+    def _weighted_share(self, tenant: str, budget_tokens: int, key: str) -> int:
+        """The one WFQ computation both tiers use: ``tenant``'s weighted
+        slice of ``budget_tokens`` over the tenants active in the
+        residency column ``key`` ('device' counts host residency too —
+        any presence keeps a device quota; 'host' is host-only). The
+        asker always joins the active set, so a lone tenant owns the
+        whole budget and a newcomer gets a real quote."""
+        # Snapshot the table (one C-level op) — GET /cache reads this
+        # cross-thread while the worker may be inserting a new tenant.
+        tenants = list(self._tenants.items())
+        if key == "host":
+            active = [t for t, a in tenants if a["host"] > 0]
+        else:
+            active = [t for t, a in tenants if a["device"] > 0 or a["host"] > 0]
+        me = self.fold(tenant)
+        if me not in active:
+            active.append(me)
+        total_w = sum(self.weight(t) for t in active)
+        if total_w <= 0:
+            return budget_tokens
+        return int(budget_tokens * self.weight(me) / total_w)
+
     def fair_share_tokens(self, tenant: str, budget_tokens: int) -> int:
         """``tenant``'s weighted-fair slice of the device budget, over the
         tenants currently holding residency (a lone tenant owns the whole
         budget — single-tenant deployments see no quota at all)."""
-        # Snapshot the table (one C-level op) — GET /cache reads this
-        # cross-thread while the worker may be inserting a new tenant.
-        tenants = list(self._tenants.items())
-        active = [
-            t for t, a in tenants
-            if (a["device"] > 0 or a["host"] > 0) or t == self.fold(tenant)
-        ]
-        if self.fold(tenant) not in active:
-            active.append(self.fold(tenant))
-        total_w = sum(self.weight(t) for t in active)
-        if total_w <= 0:
-            return budget_tokens
-        return int(budget_tokens * self.weight(self.fold(tenant)) / total_w)
+        return self._weighted_share(tenant, budget_tokens, "device")
 
     def over_share(self, tenant: str, budget_tokens: int, extra: int = 0) -> bool:
         """Whether ``tenant``'s device residency (plus ``extra`` tokens it
@@ -154,6 +164,25 @@ class CacheGovernor:
     def device_tokens(self, tenant: str) -> int:
         acct = self._tenants.get(self.fold(tenant))
         return acct["device"] if acct else 0
+
+    # ----------------------------------------------------------- host tier
+    def host_tokens(self, tenant: str) -> int:
+        acct = self._tenants.get(self.fold(tenant))
+        return acct["host"] if acct else 0
+
+    def host_fair_share_tokens(self, tenant: str, budget_tokens: int) -> int:
+        """``tenant``'s weighted-fair slice of the HOST-tier budget, over
+        the tenants currently holding host residency — the same WFQ math
+        as the device quota, one tier down. Host reclaim orders victims by
+        this (deficit-weighted LRU in ``evict_host``), so a spill-heavy
+        tenant cannot flush other tenants' spilled working sets out of
+        host RAM either."""
+        return self._weighted_share(tenant, budget_tokens, "host")
+
+    def over_host_share(self, tenant: str, budget_tokens: int) -> bool:
+        return self.host_tokens(tenant) > self.host_fair_share_tokens(
+            tenant, budget_tokens
+        )
 
     # --------------------------------------------------------------- stats
     def token_hit_rate(self, tenant: str) -> float:

@@ -85,6 +85,7 @@ from mcpx.scheduler.admission import ewma_update
 from mcpx.scheduler.locality import locality_order
 from mcpx.telemetry import tracing
 from mcpx.telemetry.costs import CostRegistry, device_peaks, rounded_roofline
+from mcpx.telemetry.flight import WorkerProfiler
 from mcpx.telemetry.metrics import Metrics
 from mcpx.utils.ownership import owned_by
 
@@ -253,6 +254,11 @@ class _Slab:
         # (engine.decode span attrs). Written only when a span rides the
         # request, so the untraced hot path never touches it.
         self.cost0 = np.zeros((B, 3), np.float64)
+        # Per-row snapshot of the worker profiler's phase totals at
+        # admission (traced rows with an attached profiler only): the
+        # retirement delta is the worker-loop breakdown during the row's
+        # residency (engine.decode span worker_* attrs). None = untouched.
+        self.prof0: list[Optional[dict]] = [None] * B
         # Recurrent drafter hidden state (grammar-aware speculative
         # decoding, engine/speculative.py): an embedding-EWMA over the
         # row's emitted tokens, [B, d_model]. Host mirror holds clear
@@ -341,6 +347,7 @@ class _Slab:
             node.refs -= 1
         self.prefix[i] = ()
         self.prefix_toks[i] = 0
+        self.prof0[i] = None
 
 
 # Legal lifecycle transitions: the single source of truth for the engine
@@ -566,6 +573,18 @@ class InferenceEngine:
         # residency-delta source for engine.decode span rooflines. Worker
         # thread only.
         self._seg_cost_totals = {"flops": 0.0, "bytes": 0.0, "wall_s": 0.0}  # mcpx: owner[engine-worker]
+        # Decode-loop host profiler (telemetry/flight.py): per-iteration
+        # phase timers tiling the worker loop's wall time into named
+        # phases, surfaced via queue_stats()["worker_profile"], decode
+        # span attrs and the bench worker_profile block. None (default) =
+        # zero clock reads on the hot path; the bench's flight phase
+        # attaches one to a LIVE engine (the worker re-reads the field
+        # each iteration, so an attach/detach lands at the next tick).
+        self._profiler: Optional[WorkerProfiler] = (  # mcpx: owner[engine-worker, atomic]
+            WorkerProfiler()
+            if self.config.telemetry.flight.profile_worker
+            else None
+        )
 
     # ------------------------------------------------------------- lifecycle
     def _transition(self, to: str) -> bool:
@@ -809,7 +828,13 @@ class InferenceEngine:
         # published for the serving scheduler and /healthz.
         ps_pfx = self._prefix_cache.stats()
         tier = self._spill_tier
+        # Decode-loop host profile (telemetry/flight.py): present ONLY
+        # while a profiler is attached, so the disabled-mode queue_stats
+        # payload stays byte-identical (recorder-off parity contract).
+        prof = self._profiler
+        extra = {"worker_profile": prof.snapshot()} if prof is not None else {}
         return {
+            **extra,
             "prefix_nodes": ps_pfx["nodes"],
             "prefix_resident_pages": ps_pfx["resident_pages"],
             "prefix_hit_rate": ps_pfx["hit_rate"],
@@ -3165,19 +3190,37 @@ class InferenceEngine:
         slab = self._slab
         pending: "deque[GenerateRequest]" = deque()
         while True:
+            # Decode-loop host profiler (telemetry/flight.py): lap() marks
+            # tile the iteration's wall time into named phases; prof is
+            # re-read each iteration so a live attach/detach (bench flight
+            # phase) lands at the next tick. None (default) = no clock
+            # reads anywhere on this path.
+            prof = self._profiler
+            if prof is not None:
+                prof.loop_tick()
             self._drain_queue(
                 pending,
                 block=(not pending and slab.n_active == 0 and not self._inflight),
             )
+            if prof is not None:
+                prof.lap("drain")
             if self._stop:
                 break
             self._refresh_queue_gauges(pending)
+            if prof is not None:
+                prof.lap("host_bookkeeping")
             self._poll_admissions(slab)
+            if prof is not None:
+                prof.lap("poll")
             if self._spill_tier is not None:
                 # Complete landed device->host spill fetches (non-blocking
                 # is_ready polls; a no-op scan when nothing is in flight).
                 self._spill_tier.poll()
+                if prof is not None:
+                    prof.lap("spill_copy")
             self._reap_cancelled(slab)
+            if prof is not None:
+                prof.lap("host_bookkeeping")
             if pending and slab.n_active < slab.B:
                 try:
                     self._admit(slab, pending)
@@ -3185,16 +3228,22 @@ class InferenceEngine:
                     log.exception("admission failed; failing resident rows")
                     self._fail_rows(slab, e)
                     self._reset_pools()
+                if prof is not None:
+                    prof.lap("admit")
             if slab.n_active:
                 try:
                     # Dispatch first, THEN fetch a lagged segment's flags:
                     # the fetch's round trip rides on top of the segment the
                     # device is already computing.
                     self._dispatch_segment(slab)
+                    if prof is not None:
+                        prof.lap("dispatch")
                     self._harvest(
                         slab,
                         keep_inflight=max(0, self.config.engine.pipeline_depth - 1),
                     )
+                    if prof is not None:
+                        prof.lap("harvest")
                 except BaseException as e:  # noqa: BLE001 - keep worker alive
                     log.exception("decode segment failed; failing resident rows")
                     self._fail_rows(slab, e)
@@ -3208,6 +3257,8 @@ class InferenceEngine:
                     log.exception("segment harvest failed; failing resident rows")
                     self._fail_rows(slab, e)
                     self._reset_pools()
+                if prof is not None:
+                    prof.lap("harvest")
         # Shutdown: harvest what the device already finished — a request one
         # lagged flag-fetch away from delivery must resolve, not be failed —
         # then nothing resident, pending, or enqueued may be left hanging.
@@ -3297,8 +3348,20 @@ class InferenceEngine:
         briefly for the first arrival, then hold a short gather window so a
         burst forms one large admission cohort instead of a size-1 prefill
         followed by stragglers."""
+        prof = self._profiler
         try:
-            item = self._queue.get(timeout=0.05) if block else self._queue.get_nowait()
+            if block:
+                # Blocking waits are the worker's IDLE time — carved out of
+                # the enclosing drain lap so the profile separates "waiting
+                # for work" from "moving work".
+                t_idle = prof.mark() if prof is not None else 0.0
+                try:
+                    item = self._queue.get(timeout=0.05)
+                finally:
+                    if prof is not None:
+                        prof.carve("idle", t_idle)
+            else:
+                item = self._queue.get_nowait()
         except queue.Empty:
             return
         first_arrival = item is not None and block
@@ -3318,10 +3381,14 @@ class InferenceEngine:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return
+                t_idle = prof.mark() if prof is not None else 0.0
                 try:
                     item = self._queue.get(timeout=remaining)
                 except queue.Empty:
                     return
+                finally:
+                    if prof is not None:
+                        prof.carve("idle", t_idle)
                 if item is None:
                     self._stop = True
                     return
@@ -3421,8 +3488,12 @@ class InferenceEngine:
         # admits by shared-prefix depth against the resident tree so
         # co-resident rows maximise sharing — EDF/age-guarded so the
         # serving scheduler's deadline ordering survives the regroup.
+        prof = self._profiler
         if ecfg.prefix_cache:
+            t_ls = prof.mark() if prof is not None else 0.0
             self._locality_sort(slab, pending)
+            if prof is not None:
+                prof.carve("locality_sort", t_ls)
         if hetero:
             head_req = next((r for r in pending if not r.future.cancelled()), None)
         else:
@@ -3459,6 +3530,7 @@ class InferenceEngine:
             # (per-row matching below picks it up like any resident path).
             # A snapshot head whose KV could not be restored rebuilds here
             # too — lazily, on its first matching use after restart.
+            t_pm = prof.mark() if prof is not None else 0.0
             try:
                 if warm_head is not None:
                     if (
@@ -3480,6 +3552,9 @@ class InferenceEngine:
                 self._fail_rows(slab, e)
                 self._reset_pools()
                 return
+            finally:
+                if prof is not None:
+                    prof.carve("prefix_match", t_pm)
         if hold is not None:
             # Admission hold: page-pressure eviction inside the cohort loop
             # must never free the head this very admission is wiring into
@@ -3647,6 +3722,8 @@ class InferenceEngine:
         # the T the plan needs, restart if it grew. T is bucket-quantised
         # and monotone non-decreasing, so this terminates within
         # len(buckets) passes of pure host bookkeeping (read-only probes).
+        prof = self._profiler
+        t_pm = prof.mark() if prof is not None else 0.0
         T = base_eligible[0]
         planned: list[tuple[int, int, list[int]]] = []  # (P, budget, ids)
         while True:
@@ -3661,6 +3738,10 @@ class InferenceEngine:
             if T_needed <= T:
                 break
             T = T_needed
+        if prof is not None:
+            # The radix-probe fix-point is the admission path's pure
+            # prefix-matching cost (stage-3 re-matches are commit noise).
+            prof.carve("prefix_match", t_pm)
 
     # --- stage 3: commit — match+pin, plan the radix insert, allocate.
         cohort: list[GenerateRequest] = []
@@ -3969,6 +4050,13 @@ class InferenceEngine:
                 slab.n_traced += 1
                 tot = self._seg_cost_totals
                 slab.cost0[i] = (tot["flops"], tot["bytes"], tot["wall_s"])
+                prof = self._profiler  # one read: a live detach between
+                if prof is not None:   # check and use must not raise here
+                    # Worker-loop attribution for this row's residency:
+                    # retirement deltas these totals (engine.decode span
+                    # worker_phases_ms attr). Traced rows only — the
+                    # untraced path pays nothing.
+                    slab.prof0[i] = prof.totals_copy()
                 r.span.child(
                     "engine.queue_wait",
                     t0=r.enqueued_at,
@@ -4373,12 +4461,22 @@ class InferenceEngine:
                     # the row's residency (cost0 is per-row, the work is
                     # the slab's).
                     tot = self._seg_cost_totals
+                    prof_attrs = {}
+                    prof = self._profiler  # single read (live detach safety)
+                    if prof is not None and slab.prof0[i] is not None:
+                        # Worker-loop phase breakdown over this row's
+                        # residency (telemetry/flight.py): where the HOST
+                        # side of the decode wall went, per named phase.
+                        prof_attrs["worker_phases_ms"] = WorkerProfiler.delta_ms(
+                            slab.prof0[i], prof.totals
+                        )
                     r.span.child(
                         "engine.decode",
                         t0=slab.t_decode0[i],
                         t1=t1,
                         tokens=len(ids),
                         row=i,
+                        **prof_attrs,
                         **self._span_roofline(
                             tot["flops"] - slab.cost0[i, 0] or None,
                             tot["bytes"] - slab.cost0[i, 1] or None,

@@ -703,11 +703,17 @@ class RadixPrefixCache:
 
     @owned_by("engine-worker")
     def evict_host(self, need_bytes: int = 0) -> int:
-        """Host-tier reclaim: drop spilled leaf runs, LRU-first, until
-        ``need_bytes`` more fit the tier's byte budget. Spilled nodes are
-        refcount-0 by invariant — the consult (``refs == 0``) is kept
-        anyway so a future pinnable-host design cannot silently reclaim a
-        pinned run. Returns tokens dropped."""
+        """Host-tier reclaim: drop spilled leaf runs until ``need_bytes``
+        more fit the tier's byte budget. With a governor the ordering is
+        deficit-weighted LRU exactly like the device tier's ``_reclaim``
+        — victims come from tenants over their weighted-fair HOST share
+        first, LRU within a bucket, with the same lazy demotion when a
+        tenant drains under its share mid-pass — so a spill-heavy tenant
+        reclaims its own host residency before touching anyone else's
+        (PR 11 left this tier tenant-blind). Spilled nodes are refcount-0
+        by invariant — the consult (``refs == 0``) is kept anyway so a
+        future pinnable-host design cannot silently reclaim a pinned run.
+        Returns tokens dropped."""
         tier = self.spill
         if tier is None:
             return 0
@@ -717,7 +723,20 @@ class RadixPrefixCache:
 
         if not over():
             return 0
-        heap: list[tuple[int, int, PrefixNode]] = []
+        gov = self.governor
+        # Host budget in tokens for the fair-share math (the tier budgets
+        # bytes; shares are token-denominated like the device tier's).
+        host_budget = tier.host_bytes // max(1, tier.bytes_per_token)
+        over_cache: dict[str, bool] = {}
+
+        def prio(c: PrefixNode, fresh: bool = False) -> int:
+            if gov is None:
+                return 0
+            if fresh or c.tenant not in over_cache:
+                over_cache[c.tenant] = gov.over_host_share(c.tenant, host_budget)
+            return 0 if over_cache[c.tenant] else 1
+
+        heap: list[tuple[int, int, int, PrefixNode]] = []
         seq = 0
         stack = [self.root]
         while stack:
@@ -727,11 +746,23 @@ class RadixPrefixCache:
                     stack.append(c)
                 elif c.host is not None and c.refs == 0:
                     seq += 1
-                    heapq.heappush(heap, (c.stamp, seq, c))
+                    heapq.heappush(heap, (prio(c), c.stamp, seq, c))
         freed = 0
         while heap and over():
-            _s, _q, victim = heapq.heappop(heap)
+            pr, _s, _q, victim = heapq.heappop(heap)
             if victim.parent is None or victim.children or victim.host is None:
+                continue
+            if pr == 0 and prio(victim, fresh=True) != 0:
+                # Its tenant fell under fair host share while earlier
+                # victims drained. The re-check recomputes over-share
+                # FRESH: as tenants drain out of the host-active set,
+                # every remaining share GROWS (usage only shrinks,
+                # weights only leave), so the cached verdict can
+                # misclassify a now-under-share tenant as still over.
+                # Bucket-1 entries never need the re-check: under-share
+                # cannot become over-share mid-pass.
+                seq += 1
+                heapq.heappush(heap, (1, victim.stamp, seq, victim))
                 continue
             parent = victim.parent
             parent.children.pop(victim.tokens[: self.page_size], None)
@@ -744,7 +775,7 @@ class RadixPrefixCache:
                 and not parent.children
             ):
                 seq += 1
-                heapq.heappush(heap, (parent.stamp, seq, parent))
+                heapq.heappush(heap, (prio(parent), parent.stamp, seq, parent))
         return freed
 
     @owned_by("engine-worker")

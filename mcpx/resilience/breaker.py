@@ -172,6 +172,14 @@ class BreakerRegistry:
         b = self._breakers.get(endpoint)
         return b.is_open() if b is not None else False
 
+    def snapshot(self) -> dict[str, str]:
+        """endpoint -> effective state, for observability surfaces (the
+        flight recorder's breaker signal and diagnostic bundles). One
+        dict copy — safe against concurrent consults inserting."""
+        return {
+            e: b.effective_state() for e, b in list(self._breakers.items())
+        }
+
     def open_services(self, records: dict[str, Any]) -> set[str]:
         """Service names whose PRIMARY endpoint breaker is open — the
         ReplanPolicy exclusion feed (``records``: name → ServiceRecord)."""

@@ -35,6 +35,7 @@ from mcpx.core.errors import PlannerError, RegistryError
 from mcpx.registry.base import ServiceRecord
 from mcpx.scheduler import ShedError
 from mcpx.server.control import ControlPlane
+from mcpx.telemetry import metrics as metrics_mod
 from mcpx.telemetry import tracing
 
 log = logging.getLogger("mcpx.server")
@@ -73,7 +74,8 @@ TRACE_ID_KEY = "mcpx_trace_id"
 
 # Endpoints subject to the server.max_concurrency admission limit (the
 # planning/execution paths; observability and CRUD stay always-available).
-_LIMITED = {"/plan", "/execute", "/plan_and_execute"}
+# Shared with the flight recorder's latency-quantile derivation.
+_LIMITED = metrics_mod.LIMITED_ENDPOINTS
 
 # Observability surfaces are never traced (by route template): a scraper
 # polling /metrics or an operator paging through /traces would otherwise
@@ -81,7 +83,8 @@ _LIMITED = {"/plan", "/execute", "/plan_and_execute"}
 # dump`'s "newest trace" would be its own /traces listing.
 _UNTRACED = {
     "/metrics", "/costs", "/cache", "/traces", "/traces/{trace_id}",
-    "/healthz", "/telemetry",
+    "/healthz", "/telemetry", "/debug/anomalies",
+    "/debug/anomalies/{bundle_id}",
 }
 
 
@@ -441,6 +444,29 @@ def build_app(cp: ControlPlane) -> web.Application:
         operator's one-call view instead of scrape-only counters."""
         return web.json_response(cp.cache_stats())
 
+    async def anomalies_handler(request: web.Request) -> web.Response:
+        """Flight recorder status (mcpx/telemetry/flight.py): detector
+        states, bundle index, the latest flight snapshot. A disabled
+        recorder answers enabled:false rather than 404 so operators can
+        tell "off" from "wrong URL"."""
+        if cp.flight is None:
+            return web.json_response(
+                {"enabled": False, "detectors": {}, "bundles": []}
+            )
+        return web.json_response(cp.flight.status())
+
+    async def anomaly_bundle_handler(request: web.Request) -> web.Response:
+        """One diagnostic bundle by id (the full JSON the trip wrote —
+        flight window, traces, costs, breakers, log tail). Disk read runs
+        off the event loop inside load_bundle."""
+        if cp.flight is None:
+            return _json_error(404, "flight recorder disabled")
+        bid = request.match_info["bundle_id"]
+        bundle = await cp.flight.load_bundle(bid)
+        if bundle is None:
+            return _json_error(404, f"no bundle '{bid}' (pruned or never captured)")
+        return web.json_response(bundle)
+
     async def telemetry_handler(request: web.Request) -> web.Response:
         return web.json_response(
             {name: s.to_dict() for name, s in cp.telemetry.snapshot().items()}
@@ -548,6 +574,8 @@ def build_app(cp: ControlPlane) -> web.Application:
     app.router.add_get("/cache", cache_handler)
     app.router.add_get("/traces", traces_handler)
     app.router.add_get("/traces/{trace_id}", trace_get)
+    app.router.add_get("/debug/anomalies", anomalies_handler)
+    app.router.add_get("/debug/anomalies/{bundle_id}", anomaly_bundle_handler)
     app.router.add_get("/telemetry", telemetry_handler)
     app.router.add_get("/healthz", healthz)
     app.router.add_post("/profile/start", profile_start)
@@ -579,10 +607,24 @@ def build_app(cp: ControlPlane) -> web.Application:
         startup_task["t"] = asyncio.create_task(cp.startup())
         if cp.telemetry_mirror is not None:
             startup_task["mirror"] = asyncio.create_task(_mirror_loop())
+        if cp.flight is not None:
+            # Flight-recorder sampling loop: ~1 Hz snapshot of signals the
+            # stack already exposes; bundle writes happen off the loop
+            # inside the recorder (asyncio.to_thread).
+            startup_task["flight"] = asyncio.create_task(cp.flight.run())
 
     app.on_startup.append(on_startup)
 
     async def on_cleanup(app: web.Application) -> None:
+        fl = startup_task.pop("flight", None)
+        if fl is not None:
+            fl.cancel()
+            try:
+                await fl
+            except asyncio.CancelledError:
+                pass  # the cancel above landing, not a failure
+            except Exception:
+                log.exception("flight recorder loop died with an error")
         m = startup_task.pop("mirror", None)
         if m is not None:
             m.cancel()

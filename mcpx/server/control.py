@@ -69,6 +69,13 @@ class ControlPlane:
 
             tracer = Tracer(self.config.tracing)
         self.tracer = tracer
+        # Flight recorder & anomaly observatory (mcpx/telemetry/flight.py):
+        # the always-on telemetry timeseries + SPC detectors + diagnostic
+        # bundles. None while telemetry.flight.enabled=false — the serving
+        # path is then byte-identical (no sampling task, no state).
+        from mcpx.telemetry.flight import build_flight_recorder
+
+        self.flight = build_flight_recorder(self)
         # Degradation target: the model-free shortlist planner — it still
         # plans over the retrieval shortlist via _context, so degraded
         # service is the "shortlist planner" tier, not a blind fallback.

@@ -23,6 +23,12 @@ LATENCY_BUCKETS = (
     0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.075, 0.1, 0.15, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
 )
 
+# The rate-limited serving endpoints: the server's middleware gates these
+# (app.py) and the flight recorder derives its request_p50/p99 window
+# quantiles from exactly their latency-histogram series (flight.py) — one
+# definition so the two can never watch different endpoint subsets.
+LIMITED_ENDPOINTS = frozenset({"/plan", "/execute", "/plan_and_execute"})
+
 
 class Metrics:
     def __init__(self) -> None:

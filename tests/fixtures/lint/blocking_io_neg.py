@@ -1,0 +1,38 @@
+"""Negative fixture: the sanctioned off-loop shapes, read-mode opens, and
+writes in code no request path reaches."""
+import asyncio
+import json
+import os
+
+
+def _persist_sync(payload, path):
+    # Blocking write, but only ever dispatched via to_thread below — the
+    # executor hop is a spawn edge, never a call edge.
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)
+
+
+async def export_handler(request):
+    payload = {"ok": True}
+    # Method/function reference handed to the executor: not a call.
+    await asyncio.to_thread(_persist_sync, payload, "/tmp/out.json")
+
+    # Nested sync def + to_thread (the FileRegistry pattern).
+    def write():
+        with open("/tmp/out2.json", "w") as f:
+            json.dump(payload, f)
+
+    await asyncio.to_thread(write)
+    # Read-mode open: not a write (and string dumps builds, not writes).
+    with open("/tmp/in.json") as f:
+        data = json.load(f)
+    return json.dumps(data)
+
+
+async def aclose(self):
+    # Async, blocking write — but nothing with a `request` param reaches
+    # it: shutdown code is not the request path.
+    with open("/tmp/snapshot.json", "w") as f:
+        json.dump({"state": 1}, f)

@@ -1,0 +1,36 @@
+"""Positive fixture: blocking file writes on the event loop, in a handler
+and in a sync helper the handler calls (one and two hops)."""
+import json
+import os
+
+import numpy as np
+
+
+def _persist(payload, path):
+    # Sync helper, but called DIRECTLY from the async handler below: the
+    # write happens on the event loop all the same.
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)
+
+
+def _export(rows, path):
+    np.save(path, rows)
+
+
+def _deep(rows, path):
+    # Two hops from the handler (handler -> _via -> _deep): still on-loop.
+    _export(rows, path)
+
+
+def _via(rows, path):
+    _deep(rows, path)
+
+
+async def export_handler(request):
+    payload = {"ok": True}
+    json.dump(payload, open("/tmp/out.json", "w"))
+    _persist(payload, "/tmp/out2.json")
+    _via([1, 2, 3], "/tmp/out3.npy")
+    return payload

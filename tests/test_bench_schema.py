@@ -78,6 +78,7 @@ def _stats(**overrides):
         "spec": None,
         "prefix": None,
         "tier": None,
+        "flight": None,
         "latency_attribution": None,
         "chaos": None,
         "grammar_fallback": {"shape_only": 0, "keys_free": 0, "typed_off": 0},
@@ -101,6 +102,10 @@ def test_output_schema_carries_roofline_pallas_reason_and_verdict():
         # ISSUE 11: the tiered-KV phase block and its promoted keys.
         "tier", "tier_token_hit_rate", "tier_hit_ratio",
         "victim_token_hit_rate", "warm_restart_prefill_ratio",
+        # ISSUE 13: the flight-recorder phase block, its promoted
+        # overhead/profile keys, and the saturation warm-replan number.
+        "flight", "flight_overhead_frac", "worker_profile",
+        "replan_warm_sat_p50_ms",
     ):
         assert key in out, key
     # ISSUE 7 fields: the roofline block…
@@ -145,6 +150,42 @@ def test_output_promotes_tier_phase_acceptance_keys():
     # Skipped phase: block and promoted keys null, never absent.
     out = bench._output_json(_stats(), None, "test")
     assert out["tier"] is None and out["tier_token_hit_rate"] is None
+
+
+def test_output_promotes_flight_phase_acceptance_keys():
+    """ISSUE 13: when the flight phase ran, the overhead fraction and the
+    worker profile block are promoted to the top level (regression
+    tracking + the >=95% attribution acceptance read them there)."""
+    wp = {
+        "phases": {
+            "dispatch": {"total_s": 1.0, "share": 0.5, "count": 10,
+                         "p50_us": 100.0},
+            "idle": {"total_s": 1.0, "share": 0.5, "count": 10,
+                     "p50_us": 100.0},
+        },
+        "wall_s": 2.0,
+        "attributed_s": 2.0,
+        "attributed_frac": 1.0,
+        "iterations": 10,
+    }
+    flight = {
+        "requests": 64,
+        "plans_per_sec_off": 50.0,
+        "plans_per_sec_on": 49.5,
+        "flight_overhead_frac": 0.01,
+        "worker_profile": wp,
+        "flight_samples": 12,
+        "flight_ring_len": 12,
+        "detectors": ["p99_shift"],
+    }
+    out = bench._output_json(_stats(flight=flight), None, "test")
+    assert out["flight_overhead_frac"] == 0.01
+    assert out["worker_profile"]["attributed_frac"] == 1.0
+    # Skipped phase: block and promoted keys null, never absent.
+    out = bench._output_json(_stats(), None, "test")
+    assert out["flight"] is None and out["flight_overhead_frac"] is None
+    assert out["worker_profile"] is None
+    assert out["replan_warm_sat_p50_ms"] is None
 
 
 def test_output_roofline_never_null_even_without_accounting():

@@ -329,6 +329,73 @@ def test_adversarial_thrash_tenant_cannot_flush_victim():
     assert flushed >= 2  # LRU alone lets the thrash displace the victim
 
 
+def test_host_tier_thrash_cannot_flush_victim_spilled_set():
+    """ISSUE 13 satellite: host-tier reclaim is deficit-weighted like the
+    device tier's (PR 11 left host LRU tenant-blind) — a tenant whose
+    spills flood host RAM reclaims its OWN host runs first, and a victim
+    tenant's spilled working set under its fair host share survives."""
+    gov = CacheGovernor()
+    # Device budget 16 tokens; host budget 160 bytes = 40 tokens at the
+    # bound 4 B/token estimate -> two equal-weight tenants get a 20-token
+    # fair host share each. The victim's radix-deduped set (shared first
+    # block + two tails = 12 tokens) sits under its share in BOTH tiers.
+    _alloc, cache, tier, _dev = make_tiered(
+        n_pages=256, max_nodes=256, max_tokens=16, host_bytes=160,
+        governor=gov,
+    )
+    victim_set = [blocks(1, i) + [7] for i in range(2, 4)]
+    for seq in victim_set:
+        insert_all(cache, seq, tenant="victim")
+    # Thrash: unique 8-token runs at volume — device pressure spills a
+    # run per insert and the host budget overflows every few rounds, so
+    # host-tier reclaim runs continuously.
+    for i in range(30):
+        insert_all(cache, blocks(50 + i, 80 + i) + [7], tenant="thrash")
+        cache.check_invariants()
+    assert tier.host_evictions > 0
+    # Nothing of the victim was destroyed: its whole deduped set is still
+    # resident across the two tiers, and (once the in-flight fetches
+    # land) every victim repeat fully re-matches via host readmit.
+    assert gov.host_tokens("victim") + gov.device_tokens("victim") == 12
+    for seq in victim_set:
+        # Poll before each match: a readmit can re-spill the sibling, and
+        # an in-flight fetch must land before the next match can use it.
+        tier.poll()
+        n, _p, _node = cache.match(seq, record=False)
+        assert n == 8, "victim's spilled run was flushed by host-tier LRU"
+    cache.check_invariants()
+    # Contrast: the tenant-blind host LRU (no governor) lets the same
+    # stream flush the victim's OLDER (coldest) host runs.
+    _alloc2, cache2, tier2, _dev2 = make_tiered(
+        n_pages=256, max_nodes=256, max_tokens=16, host_bytes=160,
+    )
+    for seq in victim_set:
+        insert_all(cache2, seq)
+    # Re-stamp nothing: the victim runs are the LRU-coldest from here on.
+    for i in range(30):
+        insert_all(cache2, blocks(50 + i, 80 + i) + [7])
+    tier2.poll()
+    flushed = sum(
+        1 for seq in victim_set if cache2.match(seq, record=False)[0] == 0
+    )
+    assert flushed >= 1  # plain LRU displaced the victim's host runs
+
+
+def test_governor_host_fair_share_math():
+    gov = CacheGovernor({"big": 3.0})
+    gov.on_adopt("big", 30)
+    gov.on_adopt("small", 10)
+    # Weighted shares over host-active tenants: 3:1 of a 40-token budget.
+    assert gov.host_fair_share_tokens("big", 40) == 30
+    assert gov.host_fair_share_tokens("small", 40) == 10
+    assert not gov.over_host_share("big", 40)
+    assert gov.host_tokens("small") == 10
+    gov.on_adopt("small", 5)
+    assert gov.over_host_share("small", 40)
+    # A host-idle newcomer still gets a share quote (joins the active set).
+    assert gov.host_fair_share_tokens("new", 50) == 10  # weight 1 of 5 total
+
+
 def test_over_quota_tenant_reclaims_its_own_first():
     gov = CacheGovernor()
     _alloc, cache, tier, _dev = make_tiered(

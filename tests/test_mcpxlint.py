@@ -31,6 +31,7 @@ RULE_IDS = {
     "broad-except",
     "blank-lines",
     "unbounded-retry-loop",
+    "blocking-io-on-request-path",
     "metric-label-churn",
     "unbounded-cache-growth",
     "thread-ownership",
@@ -153,6 +154,21 @@ def test_unbounded_cache_growth_negative():
 
 
 # ------------------------------------------------- interprocedural passes
+def test_blocking_io_positive():
+    # Writes in a handler, in a directly-called sync helper, and two call
+    # hops deep — flagged at the WRITE site in every case.
+    assert hits("blocking_io_pos.py", "blocking-io-on-request-path") == [
+        13, 14, 15, 19, 33,
+    ]
+
+
+def test_blocking_io_negative():
+    # to_thread'd method reference, nested-def + to_thread (the
+    # FileRegistry pattern), read-mode open, json.dumps, and shutdown
+    # async code no request reaches — all silent.
+    assert hits("blocking_io_neg.py", "blocking-io-on-request-path") == []
+
+
 def test_thread_ownership_positive():
     # write / two reads / owned-mutator call, all from an async handler
     # whose call-graph roots never touch the worker's thread entry.
