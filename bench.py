@@ -137,6 +137,14 @@ Environment knobs:
                          acceptance) + the worker_profile block (named
                          worker-loop phases, >=95% attribution).
     MCPX_BENCH_FLIGHT_REQUESTS    flight-phase request count per round (96)
+    MCPX_BENCH_LEDGER    0 skips the cost-ledger phase (default on): the
+                         same direct-plan stream served with the
+                         per-request ledger + SLO observe off vs on
+                         (live attach) -> ledger_overhead_frac (<3%
+                         acceptance) + the attribution block (per-tenant
+                         itemized usage, wall-attribution fraction,
+                         FLOP conservation verdict).
+    MCPX_BENCH_LEDGER_REQUESTS    ledger-phase request count per round (96)
     MCPX_BENCH_OVERLOAD_FACTOR    offered load as a multiple of measured
                                   throughput (default 4)
     MCPX_BENCH_OVERLOAD_REQUESTS  overload-phase request count (default 256)
@@ -1882,6 +1890,176 @@ async def _flight_phase(cp) -> "dict | None":
     }
 
 
+async def _ledger_phase(cp) -> "dict | None":
+    """Cost-ledger & usage-attribution scenario (ISSUE 14 acceptance): the
+    SAME direct-plan workload served with the ledger fully OFF (the
+    default pass-through) and ON (engine per-row accumulators + per-tenant
+    usage fold + SLO observe), in interleaved best-of rounds like the
+    flight phase. Reports ``ledger_overhead_frac`` (1 - on/off
+    plans-per-sec, the <3% acceptance number) and the ``attribution``
+    block: per-tenant itemized usage, the mean wall-attribution fraction,
+    and the FLOP-conservation cross-check (sum of bills vs the engine's
+    apportioned totals). Skip with MCPX_BENCH_LEDGER=0."""
+    if os.environ.get("MCPX_BENCH_LEDGER", "1") == "0":
+        return None
+    engine = getattr(cp.planner, "engine", None)
+    if engine is None or engine.state != "ready":
+        return None
+    import math as _math
+    import random as _random
+
+    from mcpx.telemetry import ledger as ledger_mod
+    from mcpx.telemetry.ledger import RequestBill, UsageLedger
+    from mcpx.telemetry.slo import SLOTracker
+    from mcpx.utils.synth import intent_for
+
+    records = await cp.registry.list_services()
+    rng = _random.Random(47)
+    n = int(os.environ.get("MCPX_BENCH_LEDGER_REQUESTS", "96"))
+    rounds = 3
+    tenants = ("acme", "globex", "initech", "default")
+    concurrency = min(engine.config.engine.max_batch_size, 16)
+    base_pool = [f"{intent_for(records, rng)} [led{i}]" for i in range(8)]
+
+    async def _idle() -> None:
+        while engine._slab.n_active or engine._queue.qsize():
+            await asyncio.sleep(0.05)
+        await asyncio.sleep(0.1)
+
+    lcfg = cp.config.telemetry.ledger
+    scfg = cp.config.slo
+    usage: "UsageLedger | None" = None
+    slo: "SLOTracker | None" = None
+    tag = {"n": 0}
+
+    async def one_round(billed: bool) -> float:
+        tag["n"] += 1
+        intents = [
+            f"{base_pool[i % len(base_pool)]} r{tag['n']}-{i}" for i in range(n)
+        ]
+        await _idle()
+        sem = asyncio.Semaphore(concurrency)
+
+        async def one(k: int, intent: str) -> None:
+            async with sem:
+                tenant = tenants[k % len(tenants)]
+                if not billed:
+                    # Same tenant rotation as the ON arm: the cache
+                    # governor's per-tenant accounting must be identical
+                    # across modes, or the overhead delta would include
+                    # tenant-governance work instead of just the ledger.
+                    await cp.plan(intent, use_cache=False, tenant=tenant)
+                    return
+                # The middleware's bill lifecycle, inlined (this phase
+                # drives cp.plan directly, the flight phase's style):
+                # activate -> plan (engine items fold via the contextvar)
+                # -> finalize -> usage/SLO observe.
+                t0 = time.monotonic()
+                bill = RequestBill(tenant=tenant, endpoint="/plan", t0=t0)
+                token = ledger_mod.activate(bill)
+                try:
+                    eng0 = bill.engine_wall_ms()
+                    _, latency_ms = await cp.plan(
+                        intent, use_cache=False, tenant=tenant
+                    )
+                    bill.note_plan(latency_ms, bill.engine_wall_ms() - eng0)
+                finally:
+                    ledger_mod.deactivate(token)
+                    total_ms = (time.monotonic() - t0) * 1e3
+                    bill.finalize(status="ok", total_ms=total_ms)
+                    usage.observe(bill)
+                    slo.observe(
+                        tenant=tenant, endpoint="/plan",
+                        latency_ms=total_ms, error=False, degraded=False,
+                    )
+
+        t0 = time.monotonic()
+        await asyncio.gather(*(one(k, i) for k, i in enumerate(intents)))
+        await _idle()
+        return n / max(1e-9, time.monotonic() - t0)
+
+    prev = (lcfg.enabled, cp.ledger, cp.slo)
+    off_rates: list[float] = []
+    on_rates: list[float] = []
+    totals0 = engine.ledger_totals()
+    try:
+        for _ in range(rounds):
+            # OFF: the default pass-through (no bill anywhere).
+            lcfg.enabled = False
+            cp.ledger = cp.slo = None
+            off_rates.append(await one_round(False))
+            # ON: live-attached ledger + SLO tracker (fresh on the first
+            # ON round so the attribution block is this phase's alone).
+            if usage is None:
+                usage = UsageLedger(lcfg, metrics=cp.metrics)
+                slo = SLOTracker(scfg)
+            lcfg.enabled = True
+            cp.ledger, cp.slo = usage, slo
+            on_rates.append(await one_round(True))
+    finally:
+        lcfg.enabled, cp.ledger, cp.slo = prev
+    best_off, best_on = max(off_rates), max(on_rates)
+    snap = usage.snapshot()
+    bills = snap["recent"]
+    attributed = [b["attributed_frac"] for b in bills if b["total_ms"] > 0]
+    # FLOP conservation cross-check (the acceptance contract): the ledger
+    # aggregate (every bill folded, unbounded — the recent ring drops old
+    # bills past its cap) equals what the engine apportioned during the
+    # ON rounds (same lazy-cost availability, same rounding contract).
+    totals1 = engine.ledger_totals()
+    bill_flops = snap["totals"]["flops"]
+    engine_flops = totals1["flops"] - totals0["flops"]
+    attribution = {
+        "requests": snap["requests"],
+        "wall_attributed_frac": (
+            round(sum(attributed) / len(attributed), 4) if attributed else None
+        ),
+        "flops_per_plan": (
+            round(snap["totals"]["flops"] / snap["requests"], 1)
+            if snap["requests"]
+            else None
+        ),
+        "decode_tokens_per_plan": (
+            round(snap["totals"]["decode_tokens"] / snap["requests"], 2)
+            if snap["requests"]
+            else None
+        ),
+        "flops_conserved": bool(
+            _math.isclose(bill_flops, engine_flops, rel_tol=1e-6, abs_tol=1.0)
+        ),
+        "tenants": {
+            t: {
+                "requests": acct["requests"],
+                "decode_tokens": acct["decode_tokens"],
+                "prefill_tokens": acct["prefill_tokens"],
+                "flops": acct["flops"],
+                "decode_ms": acct["decode_ms"],
+            }
+            for t, acct in snap["tenants"].items()
+        },
+    }
+    return {
+        "requests": n,
+        "rounds": rounds,
+        "plans_per_sec_off": round(best_off, 2),
+        "plans_per_sec_on": round(best_on, 2),
+        # The acceptance number: fractional headline cost of serving with
+        # the ledger + SLO observe armed (negative = measurement noise).
+        "ledger_overhead_frac": round(1.0 - best_on / max(1e-9, best_off), 4),
+        "attribution": attribution,
+        "slo": {
+            "objectives": [
+                {
+                    "name": o["name"],
+                    "budget_remaining": o["budget_remaining"],
+                    "fast_burn": o["fast_burn"],
+                }
+                for o in slo.status()["global"]["objectives"]
+            ],
+        },
+    }
+
+
 def _attribution_from_traces(recs) -> "dict | None":
     """p50/p99 per-phase latency attribution over sampled trace records:
     where a request's wall time went, so a BENCH_*.json regression explains
@@ -2334,6 +2512,12 @@ async def _run(model_size: str, n_requests: int, concurrency: int, n_services: i
         # number may see; both detached in its finally).
         flight = await _flight_phase(cp)
 
+        # ---- Phase 11: cost ledger + usage attribution (ISSUE 14) —
+        # same live-attach discipline as the flight phase (it flips
+        # telemetry.ledger on the serving engine and attaches a usage
+        # ledger + SLO tracker, all restored in its finally).
+        ledger = await _ledger_phase(cp)
+
         # ---- Phase 5: latency attribution (ISSUE 4) — a traced open-loop
         # sample at the phase-2 rate; runs after every headline scrape
         # because attaching the tracer is the one thing this phase does
@@ -2492,6 +2676,10 @@ async def _run(model_size: str, n_requests: int, concurrency: int, n_services: i
         # skipped): recorder+profiler overhead vs the pass-through, and
         # the worker thread's wall time attributed to named phases.
         "flight": flight,
+        # Cost ledger + usage attribution scenario (None when skipped):
+        # billing overhead vs the pass-through, per-tenant itemized
+        # usage, wall-attribution fraction, FLOP conservation verdict.
+        "ledger": ledger,
         # Per-phase latency attribution from sampled request traces (None
         # when skipped): p50/p99 of scheduler-queue vs engine admit-wait vs
         # prefill vs decode vs tool fan-out, plus each phase's share of the
@@ -2974,6 +3162,19 @@ def _output_json(stats: dict, quality_trained, model: str) -> dict:
                 "worker_profile": (
                     stats["flight"]["worker_profile"]
                     if stats.get("flight") else None
+                ),
+                "ledger": stats.get("ledger"),
+                # Acceptance keys promoted to the top level (ISSUE 14):
+                # the cost ledger's fractional headline cost and the
+                # per-tenant usage-attribution block (TRACKED_METRICS
+                # reads attribution.wall_attributed_frac).
+                "ledger_overhead_frac": (
+                    stats["ledger"]["ledger_overhead_frac"]
+                    if stats.get("ledger") else None
+                ),
+                "attribution": (
+                    stats["ledger"]["attribution"]
+                    if stats.get("ledger") else None
                 ),
                 "latency_attribution": stats["latency_attribution"],
                 "chaos": stats["chaos"],

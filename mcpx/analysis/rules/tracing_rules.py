@@ -32,6 +32,18 @@ from mcpx.analysis.rules.common import (
 _TIMING_NAMES = {"time.time", "time.monotonic", "time.perf_counter"}
 _LOOP_FACTORIES = {"asyncio.get_event_loop", "asyncio.get_running_loop"}
 
+# WALL clocks only (wall-clock-duration rule): reads that jump with NTP
+# slews/steps and must never be differenced into a duration on the
+# serving path — SLO windows and ledger bills are monotonic-clock
+# contracts (time.monotonic / time.perf_counter).
+_WALL_CLOCK_NAMES = {
+    "time.time",
+    "datetime.now",
+    "datetime.utcnow",
+    "datetime.datetime.now",
+    "datetime.datetime.utcnow",
+}
+
 
 def _is_timing_call(node: ast.AST) -> bool:
     if not isinstance(node, ast.Call):
@@ -47,6 +59,57 @@ def _is_timing_call(node: ast.AST) -> bool:
         if isinstance(f.value, ast.Call) and call_name(f.value) in _LOOP_FACTORIES:
             return True
     return False
+
+
+def _is_wall_clock_call(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and call_name(node) in _WALL_CLOCK_NAMES
+
+
+@rule(
+    "wall-clock-duration",
+    "wall-clock (time.time/datetime.now) delta used as a duration in "
+    "request-path async code — durations must be monotonic-clock",
+)
+def check_wall_clock_duration(ctx: FileContext) -> Iterator[Finding]:
+    """Flags a subtraction whose BOTH sides are wall-clock-derived (a
+    direct ``time.time()``/``datetime.now()`` call, or a name assigned
+    from one in the same function) inside async request-path code. A
+    wall-clock pair differenced into an interval jumps with NTP
+    slews/steps — an SLO window or a request bill built on it lies
+    exactly when clocks misbehave. One wall-clock operand against a
+    non-clock value stays silent: cross-host timestamp comparisons
+    (telemetry mirror TTLs) have no monotonic alternative. Offline
+    harnesses (any ``benchmarks/`` path segment) are exempt, like
+    span-across-await-blocking."""
+    parts = ctx.relpath.split("/")
+    if "benchmarks" in parts:
+        return
+    for fn in async_functions(ctx.tree):
+        assigns: set[str] = set()
+        for node in walk_scope(fn):
+            if isinstance(node, ast.Assign) and _is_wall_clock_call(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        assigns.add(t.id)
+
+        def _wall_derived(side: ast.AST) -> bool:
+            if _is_wall_clock_call(side):
+                return True
+            return isinstance(side, ast.Name) and side.id in assigns
+
+        for node in walk_scope(fn):
+            if not (isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub)):
+                continue
+            if _wall_derived(node.left) and _wall_derived(node.right):
+                yield ctx.finding(
+                    node.lineno,
+                    "wall-clock-duration",
+                    f"wall-clock delta used as a duration in async "
+                    f"'{fn.name}' — time.time()/datetime.now() jump with "
+                    "NTP; measure request-path intervals with "
+                    "time.monotonic() (SLO windows and ledger bills are "
+                    "monotonic-clock contracts)",
+                )
 
 
 @rule(

@@ -58,6 +58,8 @@ TRACKED_METRICS: tuple[tuple[str, str, Optional[str]], ...] = (
     ("replan_p50_warm_ms", "lower", None),
     ("replan_warm_sat_p50_ms", "lower", None),
     ("flight_overhead_frac", "lower", None),
+    ("ledger_overhead_frac", "lower", None),
+    ("attribution.wall_attributed_frac", "higher", None),
     ("tier_token_hit_rate", "higher", None),
     ("tier_hit_ratio", "higher", None),
     ("victim_token_hit_rate", "higher", None),

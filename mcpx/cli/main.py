@@ -162,6 +162,61 @@ def cmd_debug(args: argparse.Namespace) -> int:
         return 1
 
 
+def cmd_usage(args: argparse.Namespace) -> int:
+    """Per-tenant usage ledger from a running server (mcpx/telemetry/
+    ledger.py, docs/observability.md "Cost ledger & SLO budgets"):
+    itemized cost aggregates per tenant + recent bills — the CLI half of
+    the GET /usage round trip the acceptance tests gate on."""
+    base = args.url.rstrip("/")
+    try:
+        out = _http_json(f"{base}/usage")
+    except RuntimeError as e:
+        print(json.dumps({"error": str(e)}))
+        return 1
+    if not out.get("enabled"):
+        print(json.dumps({"error": "cost ledger disabled on the server"}))
+        return 1
+    if args.tenant:
+        acct = out.get("tenants", {}).get(args.tenant)
+        out = {
+            "enabled": True,
+            "tenant": args.tenant,
+            "totals": acct,
+            "recent": [
+                b for b in out.get("recent", []) if b.get("tenant") == args.tenant
+            ],
+        }
+        if acct is None:
+            out["error"] = f"no usage recorded for tenant '{args.tenant}'"
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=2)
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+def cmd_slo(args: argparse.Namespace) -> int:
+    """SLO error-budget state from a running server (mcpx/telemetry/
+    slo.py): per-objective burn rates and budget remaining, global + per
+    tenant. Exit 3 when any global objective is breaching (fast burn at
+    or over the page threshold) so scripts can gate on budget health."""
+    base = args.url.rstrip("/")
+    try:
+        out = _http_json(f"{base}/slo")
+    except RuntimeError as e:
+        print(json.dumps({"error": str(e)}))
+        return 1
+    if not out.get("enabled"):
+        print(json.dumps({"error": "SLO engine disabled on the server"}))
+        return 1
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=2)
+    print(json.dumps(out, indent=2))
+    breaching = bool(out.get("global", {}).get("breaching"))
+    return 3 if breaching else 0
+
+
 def cmd_validate(args: argparse.Namespace) -> int:
     """Validate a plan JSON file against the DAG schema."""
     from mcpx.core.dag import Plan, PlanValidationError
@@ -369,6 +424,34 @@ def main(argv: list[str] | None = None) -> int:
         help="output path for bundle (default: bundle_<id>.json)",
     )
     p_debug.set_defaults(func=cmd_debug)
+
+    p_usage = sub.add_parser(
+        "usage", help="per-tenant usage ledger from a running server"
+    )
+    p_usage.add_argument(
+        "--url", default="http://127.0.0.1:8000",
+        help="server base URL (default: %(default)s)",
+    )
+    p_usage.add_argument(
+        "--tenant", default="",
+        help="show one tenant's totals + recent bills only",
+    )
+    p_usage.add_argument(
+        "--out", default="", help="also write the report to this path"
+    )
+    p_usage.set_defaults(func=cmd_usage)
+
+    p_slo = sub.add_parser(
+        "slo", help="SLO error-budget state from a running server"
+    )
+    p_slo.add_argument(
+        "--url", default="http://127.0.0.1:8000",
+        help="server base URL (default: %(default)s)",
+    )
+    p_slo.add_argument(
+        "--out", default="", help="also write the report to this path"
+    )
+    p_slo.set_defaults(func=cmd_slo)
 
     p_val = sub.add_parser("validate", help="validate a plan JSON file")
     p_val.add_argument("file", help="path or - for stdin")

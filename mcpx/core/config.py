@@ -361,6 +361,59 @@ class FlightConfig:
 
 
 @dataclass
+class LedgerConfig:
+    """Per-request cost ledger & per-tenant usage attribution
+    (mcpx/telemetry/ledger.py, docs/observability.md "Cost ledger & SLO
+    budgets"): every admitted request accumulates an itemized bill
+    (queue waits, prefill/decode walls and tokens, apportioned FLOPs/HBM
+    bytes, KV page·seconds, prefix tokens saved, tool attempts), attached
+    to the root span and rolled up per tenant at GET /usage. Off by
+    default: with ``enabled=false`` no bill exists anywhere on the
+    serving path — token outputs, queue_stats and the metrics exposition
+    (modulo the registered-but-empty mcpx_ledger_* families) are
+    byte-identical (parity-tested)."""
+
+    enabled: bool = False
+    # Distinct tenants tracked before new names fold into "other" — the
+    # cache governor's fold-at-64 discipline; bounds both the usage map
+    # and the mcpx_ledger_* label space.
+    max_tenants: int = 64
+    # Finalized bills retained in the in-memory ring served by GET /usage
+    # (oldest evicted; 0 disables the ring, aggregates still accumulate).
+    recent: int = 256
+
+
+@dataclass
+class SLOConfig:
+    """SLO error-budget engine (mcpx/telemetry/slo.py): declarative
+    objectives over the serving path, multi-window multi-burn-rate
+    tracking, budget state per tenant + global at GET /slo. Off by
+    default (no tracker, no per-request observe)."""
+
+    enabled: bool = False
+    # Objectives as a list of {"name", "kind", "target"[, "threshold_ms"]}
+    # dicts; kind in latency|availability|plan_quality. Empty = the
+    # defaults (slo.DEFAULT_OBJECTIVES): p99<1s @ 99%, availability
+    # 99.9%, primary-tier plan share 90%.
+    objectives: list = field(default_factory=list)
+    # Burn windows, seconds, ascending: the first two are the FAST pair
+    # (multi-window AND for the fast-burn signal), the last is the budget
+    # period. Defaults: 5m / 1h / 6h / 3d.
+    windows_s: list = field(
+        default_factory=lambda: [300.0, 3600.0, 21600.0, 259200.0]
+    )
+    # Event-count bucket granularity; windows are sums of bucket tails.
+    bucket_s: float = 60.0
+    # Fast-burn page threshold: burn >= this in BOTH fast windows trips
+    # the flight recorder's slo_burn detector and (when
+    # scheduler.burn_aware) engages the degradation ladder. 14.4 spends a
+    # 3d budget in ~5h — the SRE-workbook page number.
+    fast_burn_threshold: float = 14.4
+    # Distinct tenants tracked before folding into "other".
+    max_tenants: int = 64
+
+
+@dataclass
 class TelemetryConfig:
     enabled: bool = True
     # EWMA smoothing for per-service latency/error-rate.
@@ -383,6 +436,9 @@ class TelemetryConfig:
     # Flight recorder + anomaly detectors + worker-loop profiler
     # (mcpx/telemetry/flight.py; see FlightConfig).
     flight: FlightConfig = field(default_factory=FlightConfig)
+    # Per-request cost ledger + per-tenant usage attribution
+    # (mcpx/telemetry/ledger.py; see LedgerConfig).
+    ledger: LedgerConfig = field(default_factory=LedgerConfig)
     # Replan when a node's observed error-rate breaches this threshold.
     replan_error_rate: float = 0.5
     # or when latency exceeds this multiple of the registry's cost profile.
@@ -492,6 +548,13 @@ class SchedulerConfig:
     degrade_min_hold_s: float = 2.0
     # Floor for the 429 Retry-After estimate.
     shed_retry_after_s: float = 1.0
+    # Burn-aware degradation (requires slo.enabled): the ladder also
+    # consults the SLO error-budget engine — while the global fast-burn
+    # signal is at/over slo.fast_burn_threshold, grants route to the
+    # degraded tier even before the queue-wait EWMA crosses its own
+    # threshold, so overload sheds burn-aware instead of blind. Off by
+    # default: the ladder is exactly the pre-SLO queue-wait controller.
+    burn_aware: bool = False
 
 
 @dataclass
@@ -578,6 +641,7 @@ class MCPXConfig:
     server: ServerConfig = field(default_factory=ServerConfig)
     tracing: TracingConfig = field(default_factory=TracingConfig)
     scheduler: SchedulerConfig = field(default_factory=SchedulerConfig)
+    slo: SLOConfig = field(default_factory=SLOConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     registry: RegistryConfig = field(default_factory=RegistryConfig)
     model: ModelConfig = field(default_factory=ModelConfig)
@@ -747,6 +811,56 @@ class MCPXConfig:
             problems.append(
                 "telemetry.flight.bundle_dir must be set while the "
                 "recorder is enabled (bundles need somewhere to land)"
+            )
+        lg = self.telemetry.ledger
+        if lg.max_tenants < 1:
+            problems.append("telemetry.ledger.max_tenants must be >= 1")
+        if lg.recent < 0:
+            problems.append("telemetry.ledger.recent must be >= 0")
+        so = self.slo
+        if not isinstance(so.windows_s, list) or len(so.windows_s) < 2:
+            problems.append("slo.windows_s must list >= 2 window lengths")
+        elif any(
+            not isinstance(w, (int, float)) or w <= 0 for w in so.windows_s
+        ) or list(so.windows_s) != sorted(so.windows_s):
+            problems.append("slo.windows_s must be positive and ascending")
+        if so.bucket_s <= 0:
+            problems.append("slo.bucket_s must be > 0")
+        if so.fast_burn_threshold <= 0:
+            problems.append("slo.fast_burn_threshold must be > 0")
+        if so.max_tenants < 1:
+            problems.append("slo.max_tenants must be >= 1")
+        if not isinstance(so.objectives, list):
+            problems.append("slo.objectives must be a list of objective objects")
+        else:
+            for i, spec in enumerate(so.objectives):
+                if not isinstance(spec, dict):
+                    problems.append(f"slo.objectives[{i}] must be an object")
+                    continue
+                kind = spec.get("kind")
+                if kind not in ("latency", "availability", "plan_quality"):
+                    problems.append(
+                        f"slo.objectives[{i}].kind {kind!r} not in "
+                        "latency|availability|plan_quality"
+                    )
+                if not spec.get("name"):
+                    problems.append(f"slo.objectives[{i}] needs a name")
+                tgt = spec.get("target")
+                if not isinstance(tgt, (int, float)) or not 0.0 < tgt < 1.0:
+                    problems.append(
+                        f"slo.objectives[{i}].target must be in (0, 1)"
+                    )
+                if kind == "latency" and not (
+                    isinstance(spec.get("threshold_ms"), (int, float))
+                    and spec["threshold_ms"] > 0
+                ):
+                    problems.append(
+                        f"slo.objectives[{i}] (latency) needs threshold_ms > 0"
+                    )
+        if self.scheduler.burn_aware and not so.enabled:
+            problems.append(
+                "scheduler.burn_aware requires slo.enabled (the ladder "
+                "consults the error-budget engine's burn state)"
             )
         if self.retrieval.top_k < 1:
             problems.append("retrieval.top_k must be >= 1")

@@ -83,6 +83,7 @@ from mcpx.planner.grammar import (
 )
 from mcpx.scheduler.admission import ewma_update
 from mcpx.scheduler.locality import locality_order
+from mcpx.telemetry import ledger as ledger_mod
 from mcpx.telemetry import tracing
 from mcpx.telemetry.costs import CostRegistry, device_peaks, rounded_roofline
 from mcpx.telemetry.flight import WorkerProfiler
@@ -171,6 +172,12 @@ class GenerateResult:
     queue_ms: float
     prefill_ms: float
     decode_ms: float
+    # Engine portion of the request's cost-ledger bill (telemetry/ledger.py):
+    # a FRESH dict built by the worker at retirement — handed across the
+    # thread boundary by value, folded into the contextvar bill back on the
+    # request task (generate()). None while telemetry.ledger is off, so the
+    # disabled path carries no billing state at all.
+    bill: Optional[dict] = None
 
 
 def _bucket(n: int, buckets: tuple[int, ...]) -> int:
@@ -259,6 +266,18 @@ class _Slab:
         # retirement delta is the worker-loop breakdown during the row's
         # residency (engine.decode span worker_* attrs). None = untouched.
         self.prof0: list[Optional[dict]] = [None] * B
+        # Per-row cost-ledger accumulators (telemetry/ledger.py), written
+        # ONLY while telemetry.ledger is enabled (engine._ledger_on) —
+        # ledger-off leaves every array untouched, the pass-through
+        # contract. Cleared with the row; the retirement bill reads them.
+        self.bill_flops = np.zeros((B,), np.float64)   # apportioned XLA flops
+        self.bill_bytes = np.zeros((B,), np.float64)   # apportioned HBM bytes
+        self.bill_fwd = np.zeros((B,), np.int64)       # forwards while resident
+        self.bill_spec = np.zeros((B,), np.int64)      # accepted spec tokens
+        self.bill_copy = np.zeros((B,), np.int64)      # readmit copy tokens
+        self.bill_pages = np.zeros((B,), np.int32)     # row-private KV pages
+        self.suffix_toks = np.zeros((B,), np.int32)    # suffix tokens prefilled
+        self.admit_t = np.zeros((B,), np.float64)      # admission timestamp
         # Recurrent drafter hidden state (grammar-aware speculative
         # decoding, engine/speculative.py): an embedding-EWMA over the
         # row's emitted tokens, [B, d_model]. Host mirror holds clear
@@ -348,6 +367,14 @@ class _Slab:
         self.prefix[i] = ()
         self.prefix_toks[i] = 0
         self.prof0[i] = None
+        self.bill_flops[i] = 0.0
+        self.bill_bytes[i] = 0.0
+        self.bill_fwd[i] = 0
+        self.bill_spec[i] = 0
+        self.bill_copy[i] = 0
+        self.bill_pages[i] = 0
+        self.suffix_toks[i] = 0
+        self.admit_t[i] = 0.0
 
 
 # Legal lifecycle transitions: the single source of truth for the engine
@@ -585,6 +612,58 @@ class InferenceEngine:
             if self.config.telemetry.flight.profile_worker
             else None
         )
+        # Per-request cost ledger (telemetry/ledger.py): while on, the
+        # worker fills the slab's per-row bill accumulators and attaches
+        # an itemized bill dict to every GenerateResult. Off (default) no
+        # accumulator is ever written and GenerateResult.bill stays None
+        # (pass-through parity). Re-read from config each worker decision
+        # point so bench can flip it on a LIVE engine like the profiler.
+        self._ledger_totals = {  # mcpx: owner[engine-worker, atomic]
+            "flops": 0.0, "bytes": 0.0, "by_executable": {},
+        }
+
+    @property
+    def _ledger_on(self) -> bool:
+        return bool(self.config.telemetry.ledger.enabled)
+
+    def ledger_totals(self) -> dict:
+        """Cross-thread snapshot of everything the ledger has apportioned
+        (GIL-atomic dict swap, queue_stats discipline): total flops/bytes
+        handed out to request bills plus the per-executable split — the
+        conservation contract's reference side (sum of bills == these
+        totals == the cost observatory's harvested per-call costs)."""
+        t = self._ledger_totals
+        return {
+            "flops": t["flops"],
+            "bytes": t["bytes"],
+            "by_executable": dict(t["by_executable"]),
+        }
+
+    def _ledger_account(
+        self, entry: Any, name: str, rows: list[int], slab: "_Slab"
+    ) -> None:
+        """Apportion one harvested executable call's XLA cost equally over
+        the rows resident for it (row-residency share) into the per-row
+        bill accumulators; accumulate exactly what was handed out into
+        the swap-in-whole totals. Worker thread only."""
+        if entry is None or not rows:
+            return
+        entry.ensure()  # lazy AOT materialisation, idempotent per signature
+        if entry.flops is None:
+            return
+        fshare = entry.flops / len(rows)
+        bshare = (entry.bytes_accessed or 0.0) / len(rows)
+        for i in rows:
+            slab.bill_flops[i] += fshare
+            slab.bill_bytes[i] += bshare
+        t = self._ledger_totals
+        by = dict(t["by_executable"])
+        by[name] = by.get(name, 0.0) + fshare * len(rows)
+        self._ledger_totals = {
+            "flops": t["flops"] + fshare * len(rows),
+            "bytes": t["bytes"] + bshare * len(rows),
+            "by_executable": by,
+        }
 
     # ------------------------------------------------------------- lifecycle
     def _transition(self, to: str) -> bool:
@@ -738,6 +817,13 @@ class InferenceEngine:
             )
             self._queue.put(req)
             res = await req.future
+            if res.bill is not None:
+                # Fold the worker's engine bill into the request's ledger
+                # bill (contextvar — this runs back on the request task, so
+                # all bill mutation stays on the event loop).
+                bill = ledger_mod.current_bill()
+                if bill is not None:
+                    bill.add_engine(res.bill)
             if esp is not None:
                 esp.set(
                     tokens=res.generated_tokens,
@@ -1652,7 +1738,9 @@ class InferenceEngine:
         this replaces."""
         now = time.monotonic()
         while self._pending_admissions:
-            t0, marker, rows, gens, t_admit0, pf_entry = self._pending_admissions[0]
+            (
+                t0, marker, rows, gens, t_admit0, pf_entry, pf_name,
+            ) = self._pending_admissions[0]
             if not marker.is_ready():
                 # Purge entries whose rows were ALL cancelled/reaped before
                 # the marker resolved — otherwise they hold device handles
@@ -1667,6 +1755,17 @@ class InferenceEngine:
                 return
             self._pending_admissions.pop(0)
             dt = (now - t0) * 1e3
+            if self._ledger_on:
+                # Prefill cost apportionment (cost ledger): the cohort
+                # executable's XLA cost split equally over the rows still
+                # alive at chain completion (row-residency share; a row
+                # reaped mid-chain forfeits its share, so the totals stay
+                # exactly what the bills received).
+                live = [
+                    i for i, g in zip(rows, gens)
+                    if slab.req[i] is not None and slab.gen[i] == g
+                ]
+                self._ledger_account(pf_entry, pf_name, live, slab)
             for i, g in zip(rows, gens):
                 if slab.req[i] is None or slab.gen[i] != g:
                     continue
@@ -3752,6 +3851,8 @@ class InferenceEngine:
         sids: list[tuple] = []
         row_pages: list[list[int]] = []
         pushback: list[GenerateRequest] = []
+        ledger_on = self._ledger_on
+        tier = self._spill_tier
         for k, (r, slot) in enumerate(cands):
             if pushback:
                 pushback.append(r)
@@ -3759,6 +3860,12 @@ class InferenceEngine:
             P, budget, ids = planned[k]
             mnode: Optional[PrefixNode] = None
             mpages: list[int] = []
+            # Readmit copy tokens this request's match pulls host->device
+            # (cost-ledger item): _try_readmit runs inside cache.match, so
+            # the tier's counter delta around it is exactly this row's bill.
+            copy0 = (
+                tier.readmit_tokens if (ledger_on and tier is not None) else 0
+            )
             if P > 0:
                 # record=False: hit/miss accounting happens AFTER the
                 # degrade decision below — a match the row cannot use
@@ -3834,7 +3941,14 @@ class InferenceEngine:
             budgets.append(budget)
             slots.append(slot)
             nodes = tuple(n for n in (mnode, inode) if n is not None)
-            prefixes.append((P, mpages + (inode.pages if inode else []), nodes))
+            copy_toks = (
+                tier.readmit_tokens - copy0
+                if (ledger_on and tier is not None)
+                else 0
+            )
+            prefixes.append(
+                (P, mpages + (inode.pages if inode else []), nodes, copy_toks)
+            )
             sids.append(sid)
             row_pages.append(pages)
         for r in reversed(pushback):
@@ -3880,7 +3994,7 @@ class InferenceEngine:
             # shared, read-only tree run; the suffix prefill writes
             # [P, P+len(ids)) into the inserted+private pages; decode
             # writes land strictly past the prompt, in private pages.
-            P, shared_pages, _nodes = prefixes[j]
+            P, shared_pages, _nodes, _copy = prefixes[j]
             positions[j] = P
             any_prefix = any_prefix or P > 0
             n_pp = P // psz
@@ -3930,6 +4044,7 @@ class InferenceEngine:
                     self._paged_kv["v"],
                 )
                 pf_entry = getattr(self._jit_suffix_prefill, "last_entry", None)
+                pf_name = "suffix_prefill"
             else:
                 (
                     tokens_d, lens_d, table_d, budgets_d, active_d,
@@ -3958,6 +4073,7 @@ class InferenceEngine:
                     ring=use_ring,
                 )
                 pf_entry = getattr(self._jit_prefill, "last_entry", None)
+                pf_name = "prefill"
             # Pools were donated to prefill: point at the live buffers
             # immediately so an exception below can't leave stale handles.
             self._paged_kv = {"k": k_p, "v": v_p}
@@ -4069,6 +4185,15 @@ class InferenceEngine:
             # pins — clear_row releases them at retirement.
             slab.prefix[i] = prefixes[j][2]
             slab.prefix_toks[i] = prefixes[j][0]
+            if ledger_on:
+                # Cost-ledger admission facts: suffix tokens this row
+                # actually prefills, its private page allocation (the
+                # page·seconds base), the readmit copy tokens its match
+                # pulled, and the residency clock start.
+                slab.suffix_toks[i] = int(seq_lens[j])
+                slab.bill_pages[i] = len(row_pages[j])
+                slab.bill_copy[i] = int(prefixes[j][3])
+                slab.admit_t[i] = t1
         if hetero:
             self.metrics.resident_grammars.set(
                 sum(1 for n in self._dfa_slot_refs[1:] if n > 0)
@@ -4129,7 +4254,7 @@ class InferenceEngine:
         self._pending_admissions.append(
             (
                 t1, slab.dev[4], rows_idx,
-                [int(slab.gen[i]) for i in rows_idx], t0, pf_entry,
+                [int(slab.gen[i]) for i in rows_idx], t0, pf_entry, pf_name,
             )
         )
         self.metrics.kv_page_utilization.set(self._allocator.stats().utilization)
@@ -4268,9 +4393,12 @@ class InferenceEngine:
             cur_d, pos_d, st_d, e_d, done_d, budgets_d, pt_d, buf_d,
             ptoks_d, plens_d, prev_d, temp_d, cons_d, dfa_d, hst_d,
         )
-        # Dispatch timestamp only when some resident request is traced: the
-        # disabled/unsampled hot path must not even pay the clock read.
-        t_disp = time.monotonic() if slab.n_traced else 0.0
+        # Dispatch timestamp only when some resident request is traced (or
+        # the cost ledger is billing): the disabled/unsampled hot path must
+        # not even pay the clock read.
+        t_disp = (
+            time.monotonic() if (slab.n_traced or self._ledger_on) else 0.0
+        )
         seg_exec = (
             self._jit_hetero_segment_spec
             if hetero and slab.spec
@@ -4284,10 +4412,13 @@ class InferenceEngine:
                 # plus the dispatch-time class snapshot they attribute by.
                 (dr_d, ac_d) if dr_d is not None else None,
                 cons_snap,
-                # The cost-registry entry of the executable just dispatched
-                # (None when cost accounting is off): harvest attributes
-                # the segment's XLA flops/bytes to traced spans with it.
+                # The cost-registry entry (+ executable name, for the
+                # ledger's per-executable totals) of the executable just
+                # dispatched (entry None when cost accounting is off):
+                # harvest attributes the segment's XLA flops/bytes to
+                # traced spans and request bills with it.
                 getattr(seg_exec, "last_entry", None),
+                getattr(seg_exec, "name", "segment"),
             )
         )
 
@@ -4346,7 +4477,7 @@ class InferenceEngine:
         while len(self._inflight) > keep_inflight:
             (
                 done_d, e_d, buf_d, nfwd_d, gen_snap, t_disp, spec_h, cons_snap,
-                seg_cost,
+                seg_cost, seg_name,
             ) = self._inflight.popleft()
             # ONE combined fetch (flags + out_buf): the tunnel's cost is the
             # round trip (~72ms), not the ~24KB of buffer — splitting into
@@ -4423,6 +4554,20 @@ class InferenceEngine:
                         attrs["drafted"] = int(dr[i])
                         attrs["accepted"] = int(ac[i])
                     r.span.child("engine.segment", t0=t_disp, t1=t1, **attrs)
+            if self._ledger_on:
+                # Cost-ledger accumulation for EVERY live row of this
+                # segment (not just traced ones): the whole-slab XLA cost
+                # apportioned by row-residency share, plus the forwards
+                # and accepted speculative tokens the row was resident for.
+                live = [
+                    i for i in range(slab.B)
+                    if slab.req[i] is not None and gen_snap[i] == slab.gen[i]
+                ]
+                self._ledger_account(seg_cost, seg_name, live, slab)
+                for i in live:
+                    slab.bill_fwd[i] += int(n_fwd)
+                    if ac is not None:
+                        slab.bill_spec[i] += int(ac[i])
             retired = False
             for i in range(slab.B):
                 r = slab.req[i]
@@ -4438,6 +4583,33 @@ class InferenceEngine:
                     prefill_ms=max(0.0, slab.prefill_ms[i]),
                     decode_ms=(t1 - slab.t_decode0[i]) * 1e3,
                 )
+                if self._ledger_on:
+                    # The engine's itemized bill for this request — a fresh
+                    # dict handed across the thread boundary by value; the
+                    # request task folds it into the contextvar bill
+                    # (telemetry/ledger.py). admit_t==0 means the row was
+                    # admitted before the ledger flipped on: residency
+                    # items then stay 0 rather than billing garbage.
+                    resident_s = (
+                        t1 - slab.admit_t[i] if slab.admit_t[i] > 0 else 0.0
+                    )
+                    res.bill = {
+                        "engine_queue_ms": float(res.queue_ms),
+                        "prefill_ms": float(res.prefill_ms),
+                        "decode_ms": float(res.decode_ms),
+                        "prefill_tokens": int(slab.suffix_toks[i]),
+                        "prefix_saved_tokens": int(slab.prefix_toks[i]),
+                        "decode_tokens": len(ids),
+                        "decode_forwards": int(slab.bill_fwd[i]),
+                        "spec_accepted_tokens": int(slab.bill_spec[i]),
+                        "spill_copy_tokens": int(slab.bill_copy[i]),
+                        "kv_pages": int(slab.bill_pages[i]),
+                        "kv_page_seconds": float(
+                            int(slab.bill_pages[i]) * resident_s
+                        ),
+                        "flops": float(slab.bill_flops[i]),
+                        "hbm_bytes": float(slab.bill_bytes[i]),
+                    }
                 # Smoothing follows the scheduler's configured alpha: this
                 # EWMA exists to feed queue_stats()'s ETA, which floors the
                 # scheduler's deadline-shed estimate — two reaction speeds

@@ -76,6 +76,16 @@ class Scheduler:
             clock=clock,
         )
         self._inflight = 0
+        # Burn-aware degradation (SchedulerConfig.burn_aware + the SLO
+        # error-budget engine, telemetry/slo.py): while the attached
+        # ``burning()`` callable reports the global fast-burn signal at or
+        # over the page threshold, grants route to the degraded tier even
+        # before the queue-wait EWMA crosses its own threshold — the SLO
+        # budget, not just the queue, decides when overload stops paying
+        # LLM decode. None / burn_aware=false = the blind ladder,
+        # byte-identical to the pre-SLO controller (contrast-tested).
+        self._burn_aware = bool(getattr(config, "burn_aware", False))
+        self._slo_burning: Optional[Callable[[], bool]] = None
         # Per-tier EWMAs of observed /plan service time (slot grant ->
         # release), seconds. Separate because the tiers differ by ~1000x:
         # ms-scale degraded completions folded into the primary estimate
@@ -86,6 +96,19 @@ class Scheduler:
         # earn their pessimism from real completions.
         self._service_ewma_s = 0.0
         self._degraded_ewma_s = 0.0
+
+    def attach_slo(self, burning: Callable[[], bool]) -> None:
+        """Wire the SLO tracker's ``burning()`` into the ladder (the
+        control plane calls this when scheduler.burn_aware is set)."""
+        self._slo_burning = burning
+
+    def _burn_degraded(self) -> bool:
+        if not self._burn_aware or self._slo_burning is None:
+            return False
+        try:
+            return bool(self._slo_burning())
+        except Exception:  # mcpx: ignore[broad-except] - a broken budget read must never refuse a grant; degrades to the blind ladder
+            return False
 
     # ------------------------------------------------------------- context
     def context_from_headers(self, headers: Any) -> RequestContext:
@@ -208,6 +231,12 @@ class Scheduler:
             raise
         wait_s = granted_at - ctx.enqueued_at
         degraded = self._degrade.observe_wait(wait_s)
+        if not degraded:
+            # Burn-aware tier pick (config-gated): a fast-burning error
+            # budget degrades the grant even while queue waits look fine —
+            # the multi-window burn signal carries its own hysteresis, so
+            # no extra hold state is needed here.
+            degraded = self._burn_degraded()
         if self._metrics is not None:
             self._metrics.sched_queue_wait.observe(wait_s)
             self._metrics.sched_decisions.labels(

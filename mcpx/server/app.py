@@ -35,6 +35,7 @@ from mcpx.core.errors import PlannerError, RegistryError
 from mcpx.registry.base import ServiceRecord
 from mcpx.scheduler import ShedError
 from mcpx.server.control import ControlPlane
+from mcpx.telemetry import ledger as ledger_mod
 from mcpx.telemetry import metrics as metrics_mod
 from mcpx.telemetry import tracing
 
@@ -84,8 +85,12 @@ _LIMITED = metrics_mod.LIMITED_ENDPOINTS
 _UNTRACED = {
     "/metrics", "/costs", "/cache", "/traces", "/traces/{trace_id}",
     "/healthz", "/telemetry", "/debug/anomalies",
-    "/debug/anomalies/{bundle_id}",
+    "/debug/anomalies/{bundle_id}", "/usage", "/slo",
 }
+
+# Request key the /plan handler uses to tell the middleware's SLO observe
+# about the degradation-ladder verdict when no ledger bill is active.
+DEGRADED_KEY = "mcpx_degraded"
 
 
 def build_app(cp: ControlPlane) -> web.Application:
@@ -125,13 +130,27 @@ def build_app(cp: ControlPlane) -> web.Application:
         trace_id = root.record.trace_id if root is not None else new_trace_id()
         request[TRACE_ID_KEY] = trace_id
         t0 = time.monotonic()
+        limited_path = request.path in _LIMITED
+        # Cost ledger (mcpx/telemetry/ledger.py): one bill per serving-path
+        # request while the ledger is attached (read per-request so bench
+        # can attach/detach it live, like the tracer). The bill rides a
+        # contextvar through the handler's task; scheduler/engine/executor
+        # items fold in along the way, and the finalize below rolls it
+        # into the per-tenant usage ledger + the root span.
+        ledger = cp.ledger
+        bill = bill_token = None
+        if ledger is not None and limited_path:
+            bill = ledger_mod.RequestBill(
+                tenant=_tenant_of(request), endpoint=endpoint, t0=t0
+            )
+            bill_token = ledger_mod.activate(bill)
         status = "error"
         # HTTP status class for tail sampling: only SERVER faults (5xx /
         # timeout) are always-kept — a bot scan of 404s or a stream of
         # malformed 400s must not flush the ring of the rare 5xx/SLO
         # traces keep_errors exists to preserve.
         http_status = 500
-        limited = request.path in _LIMITED
+        limited = limited_path
         try:
             with tracing.activate(root):
                 if limited and inflight["n"] >= server_cfg.max_concurrency:
@@ -173,6 +192,34 @@ def build_app(cp: ControlPlane) -> web.Application:
         finally:
             if root is not None:
                 root.set(status=status)
+            elapsed_s = time.monotonic() - t0  # mcpx: ignore[span-across-await-blocking] - the latency metric must exist when tracing is disabled or the trace unsampled
+            if bill is not None:
+                ledger_mod.deactivate(bill_token)
+                bill.finalize(status=status, total_ms=elapsed_s * 1e3)
+                if root is not None:
+                    # The itemized bill rides the root span (attached
+                    # before tracer.finish so retained traces carry it).
+                    root.set(bill=bill.to_dict())
+                ledger.observe(bill)
+            slo = cp.slo
+            if slo is not None and limited_path and http_status != 429:
+                # SLO error-budget observe (telemetry/slo.py): every
+                # SERVED request on the limited endpoints; shed/throttled
+                # 429s are excluded — burn must measure served quality,
+                # not the load shedder doing its job.
+                slo.observe(
+                    tenant=(
+                        bill.tenant if bill is not None else _tenant_of(request)
+                    ),
+                    endpoint=endpoint,
+                    latency_ms=elapsed_s * 1e3,
+                    error=status == "timeout" or http_status >= 500,
+                    degraded=(
+                        bill.degraded
+                        if bill is not None
+                        else bool(request.get(DEGRADED_KEY, False))
+                    ),
+                )
             # Retention decided BEFORE the histogram observation so the
             # exemplar only ever names a trace GET /traces/{id} can serve.
             kept = tracer.finish(
@@ -185,7 +232,7 @@ def build_app(cp: ControlPlane) -> web.Application:
                 else None
             )
             metrics.request_latency.labels(endpoint=endpoint).observe(
-                time.monotonic() - t0,  # mcpx: ignore[span-across-await-blocking] - the latency metric must exist when tracing is disabled or the trace unsampled
+                elapsed_s,
                 exemplar=exemplar,
             )
 
@@ -229,6 +276,23 @@ def build_app(cp: ControlPlane) -> web.Application:
                         verdict="degraded" if slot.degraded else "admitted",
                         queue_wait_ms=round(slot.queue_wait_s * 1e3, 3),
                     )
+        bill = ledger_mod.current_bill()
+        if slot is not None:
+            if bill is not None:
+                # Scheduler queue wait + the grant's identity/tier become
+                # bill items (the grant's tenant wins over the raw header:
+                # it is what every downstream quota charges).
+                bill.sched_queue_ms += slot.queue_wait_s * 1e3
+                bill.tenant = slot.ctx.tenant
+                bill.degraded = slot.degraded
+            if slot.degraded:
+                # SLO plan-quality observe needs the verdict even when no
+                # ledger is attached.
+                request[DEGRADED_KEY] = True
+        # Engine wall before/after the plan call: the difference between
+        # the control plane's plan latency and what the engine billed is
+        # the planner's own overhead (retrieval, grammar, prompt render).
+        eng0 = bill.engine_wall_ms() if bill is not None else 0.0
         try:
             p, latency_ms = await cp.plan(
                 intent,
@@ -252,6 +316,9 @@ def build_app(cp: ControlPlane) -> web.Application:
         finally:
             if slot is not None:
                 sched.release(slot)
+        if bill is not None:
+            bill.note_plan(latency_ms, bill.engine_wall_ms() - eng0)
+            bill.origin = p.origin or ""
         resp = {
             "graph": p.to_wire(),
             "explanation": p.explanation,
@@ -295,7 +362,16 @@ def build_app(cp: ControlPlane) -> web.Application:
                     deadline_ms = float(raw)
                 except ValueError:
                     pass  # scheduling hints never 400 a valid graph
+        bill = ledger_mod.current_bill()
+        t_ex = time.monotonic() if bill is not None else 0.0
         result = await cp.execute(plan_obj, payload, deadline_ms=deadline_ms)
+        if bill is not None:
+            # Tool-execution bill items: the DAG wall plus attempt counts
+            # by kind from the execution trace.
+            bill.add_tools(
+                result.trace.to_dict() if result.trace else None,
+                (time.monotonic() - t_ex) * 1e3,
+            )
         return web.json_response(result.to_dict())
 
     # ------------------------------------------------------ plan_and_execute
@@ -309,12 +385,24 @@ def build_app(cp: ControlPlane) -> web.Application:
             return _json_error(400, "'intent' must be a non-empty string")
         if not isinstance(payload, dict):
             return _json_error(400, "'payload' must be an object")
+        bill = ledger_mod.current_bill()
+        eng0 = bill.engine_wall_ms() if bill is not None else 0.0
+        t_ex = time.monotonic() if bill is not None else 0.0
         try:
             out = await cp.plan_and_execute(
                 intent, payload, tenant=_tenant_of(request)
             )
         except PlannerError as e:
             return _json_error(422, f"planning failed: {e}")
+        if bill is not None:
+            # Plan+execute is one structured program: the engine items
+            # folded in during planning/replanning; everything else (tool
+            # attempts, replan overhead) lands in the tool item, with
+            # attempt counts from the execution trace.
+            bill.origin = str(out.get("origin") or "")
+            wall_ms = (time.monotonic() - t_ex) * 1e3
+            eng_delta = bill.engine_wall_ms() - eng0
+            bill.add_tools(out.get("trace"), max(0.0, wall_ms - eng_delta))
         return web.json_response(out)
 
     # -------------------------------------------------------------- registry
@@ -358,6 +446,10 @@ def build_app(cp: ControlPlane) -> web.Application:
             from mcpx.telemetry.costs import update_hbm_gauges
 
             update_hbm_gauges(cp.metrics)
+        if cp.slo is not None:
+            # mcpx_slo_* gauges refresh at scrape time, like the HBM
+            # pressure gauges (cheap dict math over the bucket rings).
+            cp.slo.update_gauges(cp.metrics)
         # OpenMetrics on request (Accept negotiation): the exposition that
         # renders the exemplar trace ids the latency histograms carry —
         # a latency spike links to a concrete GET /traces/{id} trace.
@@ -467,6 +559,25 @@ def build_app(cp: ControlPlane) -> web.Application:
             return _json_error(404, f"no bundle '{bid}' (pruned or never captured)")
         return web.json_response(bundle)
 
+    async def usage_handler(request: web.Request) -> web.Response:
+        """Per-tenant usage ledger (mcpx/telemetry/ledger.py): itemized
+        cost aggregates per tenant + the recent-bill ring. A disabled
+        ledger answers enabled:false rather than 404 (operators can tell
+        "off" from "wrong URL", the /debug/anomalies convention)."""
+        if cp.ledger is None:
+            return web.json_response({"enabled": False})
+        return web.json_response(cp.ledger.snapshot())
+
+    async def slo_handler(request: web.Request) -> web.Response:
+        """SLO error-budget state (mcpx/telemetry/slo.py): per-objective
+        burn rates over every window, budget remaining, global + per
+        tenant — and a gauge refresh so /metrics agrees with what this
+        endpoint just served."""
+        if cp.slo is None:
+            return web.json_response({"enabled": False})
+        cp.slo.update_gauges(cp.metrics)
+        return web.json_response(cp.slo.status())
+
     async def telemetry_handler(request: web.Request) -> web.Response:
         return web.json_response(
             {name: s.to_dict() for name, s in cp.telemetry.snapshot().items()}
@@ -475,7 +586,16 @@ def build_app(cp: ControlPlane) -> web.Application:
     async def healthz(request: web.Request) -> web.Response:
         engine = getattr(cp.planner, "engine", None)
         engine_state = getattr(engine, "state", "n/a") if engine is not None else "n/a"
-        body: dict[str, Any] = {"status": "ok", "engine": engine_state}
+        from mcpx.server.control import _mcpx_version
+
+        # Build identity (ISSUE 14 satellite): liveness probes and bundle
+        # consumers attribute this serving process to a concrete build —
+        # the same version label mcpx_build_info carries.
+        body: dict[str, Any] = {
+            "status": "ok",
+            "version": _mcpx_version(),
+            "engine": engine_state,
+        }
         if engine_state == "ready":
             # Engine load snapshot (the scheduler's queue_stats() feed):
             # occupancy, per-class backlog, head-of-line age and resident
@@ -576,6 +696,8 @@ def build_app(cp: ControlPlane) -> web.Application:
     app.router.add_get("/traces/{trace_id}", trace_get)
     app.router.add_get("/debug/anomalies", anomalies_handler)
     app.router.add_get("/debug/anomalies/{bundle_id}", anomaly_bundle_handler)
+    app.router.add_get("/usage", usage_handler)
+    app.router.add_get("/slo", slo_handler)
     app.router.add_get("/telemetry", telemetry_handler)
     app.router.add_get("/healthz", healthz)
     app.router.add_post("/profile/start", profile_start)

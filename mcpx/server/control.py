@@ -30,6 +30,34 @@ from mcpx.telemetry.stats import TelemetryStore
 log = logging.getLogger("mcpx.control")
 
 
+def _mcpx_version() -> str:
+    import mcpx
+
+    return getattr(mcpx, "__version__", "unknown")
+
+
+def _jax_version() -> str:
+    """jax's installed version WITHOUT importing it (package metadata):
+    build identity must not initialise the JAX runtime on heuristic-only
+    servers."""
+    try:
+        from importlib.metadata import version
+
+        return version("jax")
+    except Exception:  # mcpx: ignore[broad-except] - build identity is best-effort metadata, never a startup failure
+        return "unknown"
+
+
+def _backend_label(config: MCPXConfig) -> str:
+    """The accelerator backend this build SERVES with, as configured —
+    resolved cheaply (env/planner kind), never by initialising jax."""
+    import os
+
+    if config.planner.kind != "llm":
+        return "none"
+    return os.environ.get("JAX_PLATFORMS", "") or "auto"
+
+
 class ControlPlane:
     def __init__(
         self,
@@ -69,10 +97,43 @@ class ControlPlane:
 
             tracer = Tracer(self.config.tracing)
         self.tracer = tracer
+        # Per-request cost ledger + per-tenant usage attribution
+        # (mcpx/telemetry/ledger.py) and the SLO error-budget engine
+        # (mcpx/telemetry/slo.py). Both None while disabled — the serving
+        # path then carries no bill and no SLO observe. Read per-request
+        # by the middleware so bench can attach/detach them on a live
+        # server, like the tracer and the scheduler.
+        from mcpx.telemetry.ledger import build_ledger
+        from mcpx.telemetry.slo import build_slo_tracker
+
+        self.ledger = build_ledger(self.config, self.metrics)
+        self.slo = build_slo_tracker(self.config)
+        if (
+            self.scheduler is not None
+            and self.slo is not None
+            and self.config.scheduler.burn_aware
+        ):
+            # Burn-aware degradation (config-gated): the ladder consults
+            # the error-budget engine's global fast-burn state, so
+            # overload sheds burn-aware instead of blind.
+            attach = getattr(self.scheduler, "attach_slo", None)
+            if attach is not None:
+                attach(self.slo.burning)
+        # Build identity (ISSUE 14 satellite): stamp mcpx_build_info so
+        # every scrape/bundle/usage report names the serving build. jax's
+        # version comes from package metadata — never an import, which
+        # would pull the whole runtime into heuristic-only servers.
+        self.metrics.set_build_info(
+            version=_mcpx_version(),
+            jax=_jax_version(),
+            backend=_backend_label(self.config),
+        )
         # Flight recorder & anomaly observatory (mcpx/telemetry/flight.py):
         # the always-on telemetry timeseries + SPC detectors + diagnostic
         # bundles. None while telemetry.flight.enabled=false — the serving
-        # path is then byte-identical (no sampling task, no state).
+        # path is then byte-identical (no sampling task, no state). Built
+        # AFTER the SLO tracker: the recorder's slo_burn detector watches
+        # its fast-burn signal.
         from mcpx.telemetry.flight import build_flight_recorder
 
         self.flight = build_flight_recorder(self)

@@ -330,6 +330,15 @@ _DETECTOR_SPECS: tuple[dict, ...] = (
          floor=3.0),
     # Scheduler shed-rate spike (admission refusing a burst it used to take).
     dict(name="shed_spike", signal="shed_rate", direction="high", floor=0.1),
+    # SLO fast-burn (telemetry/slo.py): the error-budget engine's
+    # multi-window fast-burn signal (worst objective, min over the fast
+    # window pair — already AND-gated against blips). The floor is the
+    # SRE-workbook page threshold: a healthy baseline sits near 0, so a
+    # trip means the budget is being spent >= 14.4x its sustainable rate
+    # in BOTH fast windows. Signal absent (SLO engine off / no traffic in
+    # a window) = sample skipped, recorder-off parity untouched.
+    dict(name="slo_burn", signal="slo_fast_burn", direction="high",
+         floor=14.4),
 )
 
 
@@ -384,6 +393,7 @@ class FlightRecorder:
         collect: Callable[[], dict],
         *,
         bundle_sources: Optional[dict[str, Callable[[], Any]]] = None,
+        detector_specs: Optional[tuple] = None,
         clock: Callable[[], float] = time.monotonic,
     ) -> None:
         self.config = config
@@ -404,7 +414,7 @@ class FlightRecorder:
                     hysteresis=config.hysteresis,
                     **spec,
                 )
-                for spec in _DETECTOR_SPECS
+                for spec in (detector_specs or _DETECTOR_SPECS)
             ]
         self._prev_raw: Optional[dict] = None
         self._prev_t: Optional[float] = None
@@ -512,6 +522,7 @@ class FlightRecorder:
         for key in (
             "queue_depth", "active_rows", "eta_s", "hol_wait_ms",
             "prefix_hit_rate", "breakers_open", "sched_degraded",
+            "slo_fast_burn",
         ):
             if key in raw:
                 signals[key] = raw[key]
@@ -805,6 +816,15 @@ def build_flight_recorder(cp: Any) -> Optional["FlightRecorder"]:
             raw["breakers_open"] = float(
                 sum(1 for st in breakers.snapshot().values() if st != "closed")
             )
+        slo = getattr(cp, "slo", None)
+        if slo is not None:
+            # The error-budget engine's multi-window fast-burn signal
+            # (telemetry/slo.py) — the slo_burn detector's watch. None
+            # (no traffic in a fast window) is left absent: detectors
+            # skip, never alarm on an idle server.
+            fb = slo.fast_burn()
+            if fb is not None:
+                raw["slo_fast_burn"] = float(fb)
         return raw
 
     def traces_source() -> list[dict]:
@@ -836,16 +856,35 @@ def build_flight_recorder(cp: Any) -> Optional["FlightRecorder"]:
             out[k] = float(v) if isinstance(v, float) else v
         return out
 
+    sources: dict[str, Callable[[], Any]] = {
+        "traces": traces_source,
+        "costs": costs_source,
+        "breakers": breakers_source,
+        "queue_stats": queue_source,
+        "cache": cp.cache_stats,
+    }
+    # Budget + usage state ride the bundle when their engines are on: an
+    # slo_burn bundle then carries WHICH objective burned and WHO spent
+    # the budget, not just the signal that tripped.
+    slo = getattr(cp, "slo", None)
+    if slo is not None:
+        sources["slo"] = slo.status
+    ledger = getattr(cp, "ledger", None)
+    if ledger is not None:
+        sources["usage"] = ledger.snapshot
+    specs = _DETECTOR_SPECS
+    if slo is not None:
+        # The slo_burn floor follows the CONFIGURED page threshold — a
+        # lowered slo.fast_burn_threshold must trip bundles at the same
+        # level it breaches /slo and engages the burn-aware ladder.
+        specs = tuple(
+            dict(s, floor=float(cp.config.slo.fast_burn_threshold))
+            if s["name"] == "slo_burn"
+            else s
+            for s in _DETECTOR_SPECS
+        )
     return FlightRecorder(
-        fcfg,
-        collect,
-        bundle_sources={
-            "traces": traces_source,
-            "costs": costs_source,
-            "breakers": breakers_source,
-            "queue_stats": queue_source,
-            "cache": cp.cache_stats,
-        },
+        fcfg, collect, bundle_sources=sources, detector_specs=specs
     )
 
 

@@ -11,6 +11,8 @@ app instances (tests!) never collide on the global default registry.
 
 from __future__ import annotations
 
+import time
+
 from prometheus_client import (
     CollectorRegistry,
     Counter,
@@ -33,6 +35,26 @@ LIMITED_ENDPOINTS = frozenset({"/plan", "/execute", "/plan_and_execute"})
 class Metrics:
     def __init__(self) -> None:
         self.registry = CollectorRegistry()
+        self._t_start = time.monotonic()
+        # Build identity + uptime (ISSUE 14 satellite): every scrape — and
+        # every diagnostic bundle / usage report derived from one — is
+        # attributable to a concrete build. The labels are set once by the
+        # control plane (set_build_info); uptime refreshes at render().
+        self.build_info = Gauge(
+            "mcpx_build_info",
+            "Constant 1; the labels carry the serving build's identity "
+            "(mcpx version, jax version, configured backend) so usage "
+            "reports and anomaly bundles attribute to a build",
+            ["version", "jax", "backend"],
+            registry=self.registry,
+        )
+        self.process_uptime = Gauge(
+            "mcpx_process_uptime_seconds",
+            "Seconds since this process's Metrics registry was created "
+            "(monotonic-clock delta, refreshed at scrape) — restarts are "
+            "visible as a reset even where counters happen to match",
+            registry=self.registry,
+        )
         self.requests = Counter(
             "mcpx_requests_total",
             "API requests",
@@ -359,6 +381,68 @@ class Metrics:
             "gives goodput model-FLOPs for MFU accounting",
             registry=self.registry,
         )
+        # Per-request cost ledger & per-tenant usage attribution
+        # (mcpx/telemetry/ledger.py, docs/observability.md "Cost ledger &
+        # SLO budgets"). All families stay empty while
+        # telemetry.ledger.enabled is false; tenant labels are bounded by
+        # the ledger's fold-at-max_tenants.
+        self.ledger_requests = Counter(
+            "mcpx_ledger_requests_total",
+            "Requests billed by the cost ledger, per tenant and final "
+            "status class",
+            ["tenant", "status"],
+            registry=self.registry,
+        )
+        self.ledger_wall_ms = Counter(
+            "mcpx_ledger_wall_ms_total",
+            "Billed request wall time by phase (sched_queue / engine_queue "
+            "/ prefill / decode / plan_other / tool, milliseconds) per "
+            "tenant — the itemized where-did-the-latency-go ledger",
+            ["tenant", "phase"],
+            registry=self.registry,
+        )
+        self.ledger_units = Counter(
+            "mcpx_ledger_units_total",
+            "Billed unit counts per tenant: prefill/decode/prefix-saved/"
+            "spec-accepted/spill-copy tokens, decode forwards, KV "
+            "page-seconds, tool attempts",
+            ["tenant", "item"],
+            registry=self.registry,
+        )
+        self.ledger_flops = Counter(
+            "mcpx_ledger_flops_total",
+            "Achieved XLA FLOPs billed per tenant, apportioned from the "
+            "cost observatory's per-executable totals by row-residency "
+            "share (sums to those totals across tenants)",
+            ["tenant"],
+            registry=self.registry,
+        )
+        self.ledger_hbm_bytes = Counter(
+            "mcpx_ledger_hbm_bytes_total",
+            "Achieved HBM bytes billed per tenant (same apportionment "
+            "contract as mcpx_ledger_flops_total)",
+            ["tenant"],
+            registry=self.registry,
+        )
+        # SLO error-budget engine (mcpx/telemetry/slo.py): global budget
+        # state per objective; per-tenant detail lives at GET /slo.
+        self.slo_budget_remaining = Gauge(
+            "mcpx_slo_budget_remaining",
+            "Fraction of the objective's error budget left over the "
+            "budget period (slowest window); < 0 = overspent. Refreshed "
+            "at scrape",
+            ["objective"],
+            registry=self.registry,
+        )
+        self.slo_burn_rate = Gauge(
+            "mcpx_slo_burn_rate",
+            "Error-budget burn rate per objective and window (1.0 = "
+            "spending exactly the budget); the fast pair feeds the "
+            "flight recorder's slo_burn detector and the burn-aware "
+            "degradation ladder",
+            ["objective", "window"],
+            registry=self.registry,
+        )
         # Scheduler (mcpx/scheduler/): admission decisions, queue wait, and
         # ladder state. outcome: admitted | degraded (admitted but routed to
         # the shortlist planner by the degradation ladder) | shed_rate |
@@ -409,11 +493,17 @@ class Metrics:
             registry=self.registry,
         )
 
+    def set_build_info(self, *, version: str, jax: str, backend: str) -> None:
+        """Stamp the build-identity labels (once, at control-plane build).
+        Idempotent: re-stamping with the same labels is a no-op series."""
+        self.build_info.labels(version=version, jax=jax, backend=backend).set(1)
+
     def render(self, *, openmetrics: bool = False) -> bytes:
         """Prometheus text exposition; ``openmetrics=True`` renders the
         OpenMetrics format instead — the only exposition that includes the
         exemplar trace ids attached to latency observations (the classic
         text format silently drops them)."""
+        self.process_uptime.set(time.monotonic() - self._t_start)
         if openmetrics:
             from prometheus_client.openmetrics.exposition import (
                 generate_latest as generate_openmetrics,

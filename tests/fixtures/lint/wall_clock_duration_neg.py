@@ -1,0 +1,29 @@
+"""Fixture: monotonic deltas, lone wall-clock timestamps, cross-host
+timestamp comparisons, and sync offline code — none may trigger
+wall-clock-duration."""
+
+import time
+
+
+async def monotonic_delta(request):
+    t0 = time.monotonic()
+    await request.app.plan(request)
+    return (time.monotonic() - t0) * 1e3  # monotonic: the correct clock
+
+
+async def timestamp_only(sink):
+    await sink.put({"at": time.time()})  # a timestamp, never differenced
+    return time.time()
+
+
+async def cross_host_ttl(obj):
+    # One wall-clock operand against a REMOTE timestamp: no monotonic
+    # alternative exists across hosts (the telemetry-mirror TTL idiom).
+    return time.time() - float(obj.get("at", 0))
+
+
+def offline_report():
+    # Sync code is outside the request path (CLI training harness idiom).
+    t0 = time.time()
+    total = sum(range(1000))
+    return total, time.time() - t0

@@ -1,0 +1,26 @@
+"""Fixture: every subtraction here differences a wall-clock pair into a
+duration in async request-path code and must trigger wall-clock-duration."""
+
+import datetime
+import time
+
+
+async def handler(request):
+    t0 = time.time()
+    result = await request.app.plan(request)
+    latency_ms = (time.time() - t0) * 1e3  # line 11: call minus tracked name
+    return result, latency_ms
+
+
+async def window(events):
+    start = datetime.datetime.now()
+    await events.drain()
+    return datetime.datetime.now() - start  # line 18: datetime pair
+
+
+async def pair(queue):
+    t0 = time.time()
+    item = await queue.get()
+    t1 = time.time()
+    wait_s = t1 - t0  # line 25: two tracked wall-clock names
+    return item, wait_s

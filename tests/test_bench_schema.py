@@ -79,6 +79,7 @@ def _stats(**overrides):
         "prefix": None,
         "tier": None,
         "flight": None,
+        "ledger": None,
         "latency_attribution": None,
         "chaos": None,
         "grammar_fallback": {"shape_only": 0, "keys_free": 0, "typed_off": 0},
@@ -106,6 +107,9 @@ def test_output_schema_carries_roofline_pallas_reason_and_verdict():
         # overhead/profile keys, and the saturation warm-replan number.
         "flight", "flight_overhead_frac", "worker_profile",
         "replan_warm_sat_p50_ms",
+        # ISSUE 14: the cost-ledger phase block, its promoted overhead
+        # key, and the per-tenant usage-attribution block.
+        "ledger", "ledger_overhead_frac", "attribution",
     ):
         assert key in out, key
     # ISSUE 7 fields: the roofline block…
@@ -186,6 +190,46 @@ def test_output_promotes_flight_phase_acceptance_keys():
     assert out["flight"] is None and out["flight_overhead_frac"] is None
     assert out["worker_profile"] is None
     assert out["replan_warm_sat_p50_ms"] is None
+
+
+def test_output_promotes_ledger_phase_acceptance_keys():
+    """ISSUE 14: when the cost-ledger phase ran, the overhead fraction
+    and the attribution block are promoted to the top level (regression
+    tracking reads ledger_overhead_frac and
+    attribution.wall_attributed_frac there)."""
+    attribution = {
+        "requests": 288,
+        "wall_attributed_frac": 0.97,
+        "flops_per_plan": 5.0e7,
+        "decode_tokens_per_plan": 9.5,
+        "flops_conserved": True,
+        "tenants": {
+            "acme": {"requests": 72, "decode_tokens": 700,
+                     "prefill_tokens": 1500, "flops": 1.2e9,
+                     "decode_ms": 9000.0},
+        },
+    }
+    ledger = {
+        "requests": 96,
+        "rounds": 3,
+        "plans_per_sec_off": 50.0,
+        "plans_per_sec_on": 49.6,
+        "ledger_overhead_frac": 0.008,
+        "attribution": attribution,
+        "slo": {"objectives": [
+            {"name": "latency_p99", "budget_remaining": 1.0,
+             "fast_burn": 0.0},
+        ]},
+    }
+    out = bench._output_json(_stats(ledger=ledger), None, "test")
+    assert out["ledger_overhead_frac"] == 0.008
+    assert out["attribution"]["wall_attributed_frac"] == 0.97
+    assert out["attribution"]["flops_conserved"] is True
+    assert out["attribution"]["tenants"]["acme"]["requests"] == 72
+    # Skipped phase: block and promoted keys null, never absent.
+    out = bench._output_json(_stats(), None, "test")
+    assert out["ledger"] is None and out["ledger_overhead_frac"] is None
+    assert out["attribution"] is None
 
 
 def test_output_roofline_never_null_even_without_accounting():

@@ -85,3 +85,64 @@ def test_invalid_speculative_rejected():
         MCPXConfig.from_dict({"engine": {"speculative": {"k": 128}}})
     with pytest.raises(ConfigError, match="speculative.draft"):
         MCPXConfig.from_dict({"engine": {"speculative": {"draft": "oracle"}}})
+
+
+def test_ledger_and_slo_config_roundtrip():
+    """ISSUE 14 satellite: telemetry.ledger.* (nested) and the slo
+    section load with key checking + string coercion, survive a to_dict
+    round-trip, and validate their knobs."""
+    cfg = MCPXConfig.from_dict(
+        {
+            "telemetry": {"ledger": {"enabled": "true", "max_tenants": "8"}},
+            "slo": {
+                "enabled": True,
+                "bucket_s": "5",
+                "windows_s": [10.0, 60.0, 120.0, 240.0],
+                "objectives": [
+                    {"name": "p99", "kind": "latency", "target": 0.95,
+                     "threshold_ms": 250.0},
+                ],
+            },
+            "scheduler": {"enabled": True, "burn_aware": True},
+        }
+    )
+    assert cfg.telemetry.ledger.enabled is True
+    assert cfg.telemetry.ledger.max_tenants == 8
+    assert cfg.slo.bucket_s == 5.0
+    round2 = MCPXConfig.from_dict(cfg.to_dict())
+    assert round2.slo.objectives == cfg.slo.objectives
+    assert round2.telemetry.ledger.max_tenants == 8
+    assert round2.scheduler.burn_aware is True
+    # Env override reaches the nested ledger section.
+    env_cfg = MCPXConfig.from_env({"MCPX_TELEMETRY_LEDGER_ENABLED": "1"})
+    assert env_cfg.telemetry.ledger.enabled is True
+    # Unknown nested key fails at load.
+    with pytest.raises(ConfigError, match="telemetry.ledger.nope"):
+        MCPXConfig.from_dict({"telemetry": {"ledger": {"nope": 1}}})
+
+
+def test_invalid_slo_rejected():
+    with pytest.raises(ConfigError, match="objectives\\[0\\].kind"):
+        MCPXConfig.from_dict(
+            {"slo": {"objectives": [{"name": "x", "kind": "vibes",
+                                     "target": 0.9}]}}
+        )
+    with pytest.raises(ConfigError, match="target"):
+        MCPXConfig.from_dict(
+            {"slo": {"objectives": [{"name": "x", "kind": "availability",
+                                     "target": 1.5}]}}
+        )
+    with pytest.raises(ConfigError, match="threshold_ms"):
+        MCPXConfig.from_dict(
+            {"slo": {"objectives": [{"name": "x", "kind": "latency",
+                                     "target": 0.9}]}}
+        )
+    with pytest.raises(ConfigError, match="windows_s"):
+        MCPXConfig.from_dict({"slo": {"windows_s": [300.0]}})
+    with pytest.raises(ConfigError, match="windows_s"):
+        MCPXConfig.from_dict({"slo": {"windows_s": [300.0, 60.0]}})
+    # burn_aware without the SLO engine is a wiring error, not a no-op.
+    with pytest.raises(ConfigError, match="burn_aware"):
+        MCPXConfig.from_dict(
+            {"scheduler": {"enabled": True, "burn_aware": True}}
+        )

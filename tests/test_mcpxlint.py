@@ -335,6 +335,22 @@ def test_span_across_await_negative():
     assert hits("span_across_await_neg.py", "span-across-await-blocking") == []
 
 
+def test_wall_clock_duration_positive():
+    # Wall-clock PAIRS differenced into durations in async code: a direct
+    # call minus a tracked assignment, a datetime.now() pair, and two
+    # tracked names (ISSUE 14 satellite — SLO windows and ledger bills
+    # are monotonic-clock contracts).
+    assert hits("wall_clock_duration_pos.py", "wall-clock-duration") == [
+        11, 18, 25,
+    ]
+
+
+def test_wall_clock_duration_negative():
+    # Monotonic deltas, lone timestamps, one-sided cross-host timestamp
+    # comparisons (mirror TTL idiom) and sync offline code all pass.
+    assert hits("wall_clock_duration_neg.py", "wall-clock-duration") == []
+
+
 def test_unbounded_retry_positive():
     # while True + for-range retry loops that await a transport call and
     # swallow its failure with no deadline or attempt bound (the aiohttp

@@ -1,0 +1,371 @@
+"""SLO error-budget engine (ISSUE 14): objective semantics, multi-window
+multi-burn-rate math under an injected clock, tenant fold, the slo_burn
+flight detector, the burn-aware degradation ladder (contrast-tested
+against the blind ladder), and the end-to-end overload -> bundle ->
+GET /slo -> CLI round trip."""
+
+import asyncio
+import json
+
+import pytest
+
+from mcpx.core.config import MCPXConfig, SchedulerConfig
+from mcpx.scheduler import Scheduler, ShedError  # noqa: F401
+from mcpx.telemetry.slo import (
+    DEFAULT_OBJECTIVES,
+    SLOObjective,
+    SLOTracker,
+    build_slo_tracker,
+)
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _tracker(clock, **kw):
+    cfg = MCPXConfig.from_dict(
+        {
+            "slo": {
+                "enabled": True,
+                "windows_s": [10.0, 60.0, 120.0, 240.0],
+                "bucket_s": 1.0,
+                **kw,
+            }
+        }
+    )
+    return SLOTracker(cfg.slo, clock=clock)
+
+
+# -------------------------------------------------------------- objectives
+def test_latency_objective_snaps_threshold_to_histogram_bucket_grid():
+    obj = SLOObjective(
+        {"name": "p99", "kind": "latency", "target": 0.99, "threshold_ms": 120}
+    )
+    # 120 ms is not a LATENCY_BUCKETS edge; it snaps UP to 150 ms, so the
+    # window good-count equals the existing histogram's le-bucket delta.
+    assert obj.threshold_ms == 150.0
+    assert obj.good(latency_ms=149.0, error=True, degraded=True)
+    assert not obj.good(latency_ms=151.0, error=False, degraded=False)
+
+
+def test_objective_kinds_and_scoping():
+    avail = SLOObjective(
+        {"name": "a", "kind": "availability", "target": 0.999}
+    )
+    quality = SLOObjective(
+        {"name": "q", "kind": "plan_quality", "target": 0.9}
+    )
+    assert avail.applies("/execute") and avail.applies("/plan")
+    assert quality.applies("/plan") and not quality.applies("/execute")
+    assert not avail.good(latency_ms=1.0, error=True, degraded=False)
+    assert not quality.good(latency_ms=1.0, error=False, degraded=True)
+    assert avail.budget == pytest.approx(0.001)
+    with pytest.raises(ValueError):
+        SLOObjective({"name": "x", "kind": "vibes", "target": 0.9})
+
+
+def test_default_objectives_cover_the_three_kinds():
+    kinds = {o["kind"] for o in DEFAULT_OBJECTIVES}
+    assert kinds == {"latency", "availability", "plan_quality"}
+
+
+# ---------------------------------------------------------- window math
+def test_burn_rates_budget_and_multiwindow_and():
+    clock = FakeClock()
+    t = _tracker(
+        clock,
+        objectives=[
+            {"name": "avail", "kind": "availability", "target": 0.9},
+        ],
+    )
+    # 40 good events spread over 40 s: every window healthy, burn 0.
+    for _ in range(40):
+        t.observe(
+            tenant="a", endpoint="/plan", latency_ms=5.0,
+            error=False, degraded=False,
+        )
+        clock.advance(1.0)
+    st = t.status()["global"]["objectives"][0]
+    assert st["windows"]["10s"]["burn_rate"] == 0.0
+    assert st["budget_remaining"] == 1.0
+    assert t.fast_burn() == 0.0 and not t.burning()
+    # A burst of pure errors: the 10 s window burns at 1/budget = 10x,
+    # the 60 s window dilutes over the healthy tail.
+    for _ in range(10):
+        t.observe(
+            tenant="a", endpoint="/plan", latency_ms=5.0,
+            error=True, degraded=False,
+        )
+        clock.advance(0.1)
+    st = t.status()["global"]["objectives"][0]
+    w10 = st["windows"]["10s"]
+    assert w10["burn_rate"] == pytest.approx(
+        (1.0 - w10["good"] / w10["total"]) / 0.1, abs=1e-6
+    )
+    assert w10["burn_rate"] > st["windows"]["60s"]["burn_rate"] > 0
+    # fast_burn is the min over the fast pair (multi-window AND): the
+    # slower fast window gates the signal.
+    assert t.fast_burn() == pytest.approx(st["windows"]["60s"]["burn_rate"])
+    # Budget remaining over the period reflects the spend.
+    assert st["budget_remaining"] < 1.0
+    # The old events age out: advance past every window, one good event.
+    clock.advance(500.0)
+    t.observe(
+        tenant="a", endpoint="/plan", latency_ms=5.0,
+        error=False, degraded=False,
+    )
+    st = t.status()["global"]["objectives"][0]
+    assert st["windows"]["240s"]["total"] == 1
+    assert st["budget_remaining"] == 1.0
+
+
+def test_no_traffic_windows_report_none_not_zero():
+    clock = FakeClock()
+    t = _tracker(clock)
+    assert t.fast_burn() is None
+    assert not t.burning()
+    st = t.status()["global"]["objectives"][0]
+    assert st["windows"]["10s"]["burn_rate"] is None
+    assert st["budget_remaining"] == 1.0  # nothing spent, nothing served
+
+
+def test_tenant_fold_and_per_tenant_status():
+    clock = FakeClock()
+    t = _tracker(clock, max_tenants=2)
+    for tenant in ("a", "b", "c", "d"):
+        t.observe(
+            tenant=tenant, endpoint="/plan", latency_ms=5.0,
+            error=tenant in ("c", "d"), degraded=False,
+        )
+    st = t.status()
+    assert set(st["tenants"]) == {"a", "b", "other"}
+    other = st["tenants"]["other"]["objectives"]
+    avail = next(o for o in other if o["kind"] == "availability")
+    assert avail["windows"]["10s"]["total"] == 2
+    assert avail["windows"]["10s"]["good"] == 0
+
+
+def test_slo_gauges_update(tmp_path):
+    from mcpx.telemetry.metrics import Metrics
+
+    clock = FakeClock()
+    t = _tracker(clock)
+    m = Metrics()
+    t.observe(
+        tenant="a", endpoint="/plan", latency_ms=5.0,
+        error=False, degraded=False,
+    )
+    t.update_gauges(m)
+    text = m.render().decode()
+    assert 'mcpx_slo_budget_remaining{objective="latency_p99"} 1.0' in text
+    assert 'mcpx_slo_burn_rate{objective="latency_p99",window="10s"} 0.0' in text
+
+
+def test_build_slo_tracker_disabled_returns_none():
+    assert build_slo_tracker(MCPXConfig()) is None
+
+
+# --------------------------------------------------- burn-aware ladder
+def _sched_cfg(**kw):
+    cfg = SchedulerConfig(enabled=True, **kw)
+    return cfg
+
+
+def test_burn_aware_ladder_contrast_with_blind_ladder():
+    """Acceptance: under identical (light) load, the blind ladder serves
+    primary while the burn-aware ladder — same waits, same config
+    otherwise — degrades because the error budget is fast-burning; it
+    recovers the moment the burn signal clears."""
+
+    async def go():
+        burning = {"v": True}
+        blind = Scheduler(_sched_cfg())
+        aware = Scheduler(_sched_cfg(burn_aware=True))
+        aware.attach_slo(lambda: burning["v"])
+        # Also prove attach without the config gate stays blind.
+        gated_off = Scheduler(_sched_cfg())
+        gated_off.attach_slo(lambda: True)
+        for s, expect in ((blind, False), (aware, True), (gated_off, False)):
+            ctx = s.context_from_headers({})
+            slot = await s.acquire(ctx)
+            assert slot.degraded is expect, s
+            s.release(slot)
+        # Burn subsides -> the aware ladder serves primary again.
+        burning["v"] = False
+        ctx = aware.context_from_headers({})
+        slot = await aware.acquire(ctx)
+        assert slot.degraded is False
+        aware.release(slot)
+        # A broken budget read degrades to the blind ladder, never fails
+        # the grant.
+        def boom() -> bool:
+            raise RuntimeError("budget backend down")
+
+        aware.attach_slo(boom)
+        ctx = aware.context_from_headers({})
+        slot = await aware.acquire(ctx)
+        assert slot.degraded is False
+        aware.release(slot)
+
+    asyncio.run(go())
+
+
+# ------------------------------------------------------------ e2e overload
+def test_overload_trips_slo_burn_bundle_endpoint_and_cli(tmp_path):
+    """The ISSUE 14 E2E: seeded slow traffic burns the latency budget,
+    the flight recorder's slo_burn detector trips, the diagnostic bundle
+    is schema-valid and carries the SLO + usage state, GET /slo shows the
+    budget burn-down, and `mcpx slo` / `mcpx usage` round-trip (slo exits
+    3 while breaching)."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from mcpx.orchestrator.transport import RouterTransport
+    from mcpx.resilience.chaos import ChaosProfile, ChaosTransport
+    from mcpx.server.app import build_app
+    from mcpx.server.factory import build_control_plane
+    from mcpx.telemetry.flight import validate_bundle
+    from tests.helpers import FakeService, make_transport
+
+    svc = FakeService("svc", result={"ok": True})
+    transport = RouterTransport(local=make_transport(svc))
+    config = MCPXConfig.from_dict(
+        {
+            "telemetry": {
+                "ledger": {"enabled": True},
+                "flight": {
+                    "enabled": True,
+                    "interval_s": 3600.0,  # test drives tick() itself
+                    "min_samples": 3,
+                    "hysteresis": 2,
+                    "cooldown_s": 0.0,
+                    "bundle_dir": str(tmp_path),
+                },
+            },
+            "slo": {
+                "enabled": True,
+                "windows_s": [10.0, 60.0, 120.0, 240.0],
+                "bucket_s": 0.5,
+                "objectives": [
+                    # Tight budget (1%): a sustained latency excursion can
+                    # push the burn far past the 14.4 page threshold (a
+                    # 10% budget caps burn at 10x — unpageable by design).
+                    {"name": "latency_p99", "kind": "latency",
+                     "target": 0.99, "threshold_ms": 100.0},
+                ],
+            },
+        }
+    )
+    cp = build_control_plane(config, transport=transport)
+    app = build_app(cp)
+    chaos = ChaosTransport(
+        transport,
+        ChaosProfile.from_dict(
+            {"seed": 7, "endpoints": {"local://svc": {"latency_ms": 250}}}
+        ),
+    )
+    GRAPH = {
+        "nodes": [
+            {"name": "a", "service": "svc", "endpoint": "local://svc",
+             "retries": 0, "timeout_s": 2.0},
+        ],
+        "edges": [],
+    }
+
+    async def go():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            fl = cp.flight
+            assert fl is not None
+            assert any(d.name == "slo_burn" for d in fl.detectors)
+
+            async def burst(n=4):
+                for _ in range(n):
+                    resp = await client.post(
+                        "/execute", json={"graph": GRAPH, "payload": {}},
+                        headers={"X-MCPX-Tenant": "acme"},
+                    )
+                    assert resp.status == 200
+
+            # Healthy baseline: sub-threshold latency, burn 0, detector arms.
+            for _ in range(6):
+                await burst()
+                await fl.tick()
+            assert cp.slo.fast_burn() == 0.0
+            # Seeded overload: every /execute now blows the 100 ms
+            # objective; the fast windows burn at 1/budget = 10x >= the
+            # 14.4-floored band around a 0 baseline only once sustained —
+            # burn climbs past it as bad events dominate both windows.
+            cp.orchestrator._transport = chaos
+            det = {d.name: d for d in fl.detectors}["slo_burn"]
+            for _ in range(12):
+                await burst()
+                await fl.tick()
+                if det.trips:
+                    break
+            assert det.trips == 1 and det.active, (
+                f"slo_burn never tripped (fast_burn={cp.slo.fast_burn()})"
+            )
+            slo_bundles = [
+                b["bundle_id"] for b in fl.bundles
+                if b["trigger"]["detector"] == "slo_burn"
+            ]
+            assert slo_bundles
+
+            # The bundle is schema-valid and carries the budget + usage
+            # state alongside the trigger.
+            bundle = await fl.load_bundle(slo_bundles[0])
+            assert validate_bundle(bundle) == []
+            assert bundle["trigger"]["detector"] == "slo_burn"
+            assert bundle["slo"]["enabled"]
+            assert bundle["usage"]["enabled"]
+            b_obj = bundle["slo"]["global"]["objectives"][0]
+            assert b_obj["breaching"] is True
+
+            # GET /slo shows the burn-down.
+            resp = await client.get("/slo")
+            st = await resp.json()
+            obj = st["global"]["objectives"][0]
+            assert st["global"]["breaching"] is True
+            assert obj["budget_remaining"] < 1.0
+            assert obj["fast_burn"] >= st["fast_burn_threshold"]
+            # Per-tenant state exists for the offending tenant.
+            assert "acme" in st["tenants"]
+
+            # CLI round trips: `mcpx slo` exits 3 while breaching and
+            # writes the same status; `mcpx usage` writes the ledger.
+            from mcpx.cli.main import main as cli_main
+
+            base = f"http://{client.server.host}:{client.server.port}"
+            slo_path = str(tmp_path / "slo.json")
+            rc = await asyncio.to_thread(
+                cli_main, ["slo", "--url", base, "--out", slo_path]
+            )
+            assert rc == 3
+            with open(slo_path) as f:
+                fetched = json.load(f)
+            assert fetched["global"]["breaching"] is True
+            usage_path = str(tmp_path / "usage.json")
+            rc = await asyncio.to_thread(
+                cli_main,
+                ["usage", "--url", base, "--tenant", "acme",
+                 "--out", usage_path],
+            )
+            assert rc == 0
+            with open(usage_path) as f:
+                usage = json.load(f)
+            assert usage["totals"]["requests"] >= 1
+            assert all(b["tenant"] == "acme" for b in usage["recent"])
+        finally:
+            cp.orchestrator._transport = transport
+            await client.close()
+
+    asyncio.run(go())
