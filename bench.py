@@ -47,7 +47,9 @@ Environment knobs:
     MCPX_BENCH_RATE_FRACTION  phase-2 offered load as a fraction of measured
                               throughput (default 0.7)
     MCPX_BENCH_LATENCY_REQUESTS  phase-2 request count (default 192)
-    MCPX_BENCH_PALLAS    0 = fused-jnp attention even on TPU (smoke ladder)
+    MCPX_BENCH_PALLAS    0 = fused-jnp attention (smoke ladder / jnp proxy);
+                         default: ragged kernel on — Mosaic on TPU, the
+                         Pallas interpreter on the CPU proxy (ISSUE 15)
     MCPX_BENCH_OVERLOAD  0 skips the scheduler overload phase (default on)
     MCPX_BENCH_MIXED     0 skips the heterogeneous mixed-traffic phase
                          (default on): constrained/free-form + two
@@ -145,6 +147,14 @@ Environment knobs:
                          itemized usage, wall-attribution fraction,
                          FLOP conservation verdict).
     MCPX_BENCH_LEDGER_REQUESTS    ledger-phase request count per round (96)
+    MCPX_BENCH_KERNEL    0 skips the ragged-kernel/fused-dispatch phase
+                         (default on): per-step vs fused decode dispatch
+                         at the same offered load on a dedicated 1×1
+                         engine → decode_dispatches_per_token +
+                         fused_decode_speedup top-level, plus the
+                         kernel-vs-jnp interpret-parity gate
+                         (BenchGateError on greedy divergence)
+    MCPX_BENCH_KERNEL_REQUESTS    kernel-phase request count (48)
     MCPX_BENCH_OVERLOAD_FACTOR    offered load as a multiple of measured
                                   throughput (default 4)
     MCPX_BENCH_OVERLOAD_REQUESTS  overload-phase request count (default 256)
@@ -437,16 +447,18 @@ def _build_config(model_size: str):
                     if env in os.environ
                 },
                 "temperature": 0.0,
-                # Derived from the live backend (like benchmarks/ladder.py):
-                # after the _device_guard CPU fallback, a pinned
-                # MCPX_BENCH_MODEL=2b (head_dim 256 passes the Pallas
-                # alignment check) must not run Mosaic TPU kernels on the
-                # CPU backend — the CPU path serves the fused-jnp
-                # reference attention instead. MCPX_BENCH_PALLAS=0 forces
-                # the fused-jnp path ON TPU too: the r5 session's 2b
-                # startup RuntimeError is unattributed between HBM OOM and
-                # a first-ever hardware Mosaic compile of the paged kernel,
-                # and the smoke ladder uses this knob to tell them apart.
+                # Kernel route (ISSUE 15): ON by default on every
+                # platform — Mosaic lowering on TPU, and on the CPU proxy
+                # _run pairs it with engine.interpret=true so the headline
+                # executes the SAME ragged kernel body through the Pallas
+                # interpreter (never bare Mosaic off-TPU, which a pinned
+                # MCPX_BENCH_MODEL=2b with its lane-aligned head_dim 256
+                # would otherwise attempt after the _device_guard CPU
+                # fallback). MCPX_BENCH_PALLAS=0 restores the fused-jnp
+                # reference on either platform: the smoke ladder uses it
+                # to split "HBM OOM" from "first-ever hardware Mosaic
+                # compile" at 2b startup, and it is the documented escape
+                # hatch back to the (faster) r08-era CPU proxy basis.
                 "use_pallas": _pallas_on(),
                 # Headline-phase heterogeneous batching (the mixed phase
                 # flips the flag per mode regardless): default off so the
@@ -2287,6 +2299,250 @@ async def _chaos_phase(cp, base: str) -> "dict | None":
     }
 
 
+async def _kernel_phase(cp) -> "dict | None":
+    """Ragged-kernel & fused-dispatch scenario (ISSUE 15 acceptance): the
+    SAME greedy mixed stream served on a DEDICATED 1×1 engine (spec-phase
+    rationale: per-chip decode economics, no virtual-mesh artifact) in two
+    dispatch cadences at the same offered load —
+
+      - **per_step**: a TRUE one-forward-per-dispatch baseline
+        (``decode_steps_per_tick=1`` AND ``steps_per_dispatch=1`` — the
+        tick is itself a fused window, so leaving it at 4 would measure
+        fused-vs-more-fused), host bookkeeping every forward — the
+        cadence whose dispatch overhead the r07 profiler billed at ~80%
+        of worker wall;
+      - **fused**: the configured fused window — one dispatch covers
+        ``decode_steps_per_tick × steps_per_dispatch`` forwards, per-row
+        done masks as data.
+
+    Reports per-arm ``decode_dispatches_per_token`` (segments/tokens
+    counter deltas — the ≥4× acceptance drop) and ``fused_decode_speedup``
+    (fused/per-step tokens-per-sec, interleaved best-of rounds — the
+    "tokens/s no worse than per-step" guard). Two honesty gates raise
+    ``BenchGateError``: greedy outputs must be byte-identical across the
+    two cadences (mid-window retirement must not change what rows emit),
+    and across the RAGGED KERNEL vs the pure-jnp reference — a second
+    dedicated engine serves the same prompts with ``use_pallas=false``
+    and every token must match (the interpret-parity gate: tier-1's CPU
+    proxy runs the same kernel body TPUs run). The kernel engine's
+    per-path ``pallas_paths`` block rides along so the phase's own route
+    is auditable. Skip with MCPX_BENCH_KERNEL=0."""
+    if os.environ.get("MCPX_BENCH_KERNEL", "1") == "0":
+        return None
+    serving = getattr(cp.planner, "engine", None)
+    if serving is None or serving.state != "ready":
+        return None
+    from mcpx.core.config import MCPXConfig
+    from mcpx.engine.engine import InferenceEngine
+
+    n = max(4, int(os.environ.get("MCPX_BENCH_KERNEL_REQUESTS", "48")))
+    base_dict = serving.config.to_dict()
+    base_dict["engine"]["data_axis"] = 1
+    base_dict["engine"]["model_axis"] = 1
+    # Hetero slab, speculation OFF: the fused window multiplies the
+    # while-loop segments only (the spec segment's unrolled iterations are
+    # deliberately excluded — see EngineConfig.steps_per_dispatch), so a
+    # spec engine would measure nothing here; the spec phase (7) already
+    # exercises the kernel's verify path.
+    base_dict["engine"]["hetero_batch"] = True
+    base_dict["engine"]["speculative"] = {"enabled": False}
+    base_dict["engine"]["warmup_compile"] = False
+    base_dict["engine"]["admit_min_free"] = 1
+    base_dict["engine"]["admit_max_wait_s"] = 0.0
+
+    def mk_engine(use_pallas: bool) -> InferenceEngine:
+        d = json.loads(json.dumps(base_dict))
+        d["engine"]["use_pallas"] = use_pallas
+        return InferenceEngine(MCPXConfig.from_dict(d), metrics=cp.metrics)
+
+    engine = mk_engine(_pallas_on())
+    await engine.start()
+    tok = engine.tokenizer
+    ecfg = engine.config.engine
+    fused_k = max(2, ecfg.steps_per_dispatch)
+    base_tick = max(1, ecfg.decode_steps_per_tick)
+    budget = max(8, min(32, ecfg.max_decode_len))
+    concurrency = min(2 * ecfg.max_batch_size, 64, max(1, n // 3))
+    # A shared prompt head so the radix cache matches and the SUFFIX
+    # prefill path (the seven-PR jnp fork this PR retires) actually runs
+    # through the kernel during the phase, not just plain decode.
+    head = "kernel phase shared header: compose the registry services."
+
+    async def _idle(eng) -> None:
+        while eng._slab.n_active or eng._queue.qsize():
+            await asyncio.sleep(0.05)
+        await asyncio.sleep(0.1)
+
+    def prompt_for(i: int) -> list[int]:
+        free = i % 3 == 2  # two constrained rows per free row
+        return (
+            tok.encode(f"{head} intent {i}: JSON:"),
+            not free,
+        )
+
+    async def one(eng, i: int, sem: asyncio.Semaphore, sink: "dict | None") -> None:
+        ids, constrained = prompt_for(i)
+        async with sem:
+            r = await eng.generate(
+                ids, max_new_tokens=budget, constrained=constrained,
+                temperature=0.0,
+            )
+        if sink is not None:
+            sink[i] = r.token_ids
+
+    async def set_cadence(eng, per_step: bool) -> None:
+        # per_step = a TRUE one-forward-per-dispatch baseline: both fusion
+        # levers at 1 (decode_steps_per_tick is itself a fused window —
+        # leaving it at 4 would measure fused-vs-more-fused). The fused
+        # arm restores the configured cadence. iters is a jit static, so
+        # each cadence is its own (warmed) executable; the flip lands at
+        # the next dispatch — flipped only on an idle slab.
+        await _idle(eng)
+        eng.config.engine.decode_steps_per_tick = 1 if per_step else base_tick
+        eng.config.engine.steps_per_dispatch = 1 if per_step else fused_k
+
+    ROUNDS = 3
+    chunk_n = max(1, n // ROUNDS)
+    concurrency = min(concurrency, chunk_n)
+    acc = {
+        m: {"tok": 0.0, "seg": 0.0, "elapsed": 0.0, "rounds": []}
+        for m in ("per_step", "fused")
+    }
+    sinks: dict = {"per_step": {}, "fused": {}}
+    warmed: set = set()
+    try:
+        for r in range(ROUNDS):
+            lo, hi = r * n // ROUNDS, (r + 1) * n // ROUNDS
+            if lo >= hi:
+                continue
+            for mode in ("per_step", "fused"):
+                await set_cadence(engine, mode == "per_step")
+                if mode not in warmed:
+                    # Untimed warm pass: compile this cadence's segment
+                    # executable (iters is a static) + prefill buckets
+                    # outside the timed region; disjoint ids so no timed
+                    # request inherits warm-request KV.
+                    warm_sem = asyncio.Semaphore(concurrency)
+                    await asyncio.gather(
+                        *(
+                            one(engine, 1_000_000 + i, warm_sem, None)
+                            for i in range(min(chunk_n, concurrency))
+                        )
+                    )
+                    await _idle(engine)
+                    warmed.add(mode)
+                prom0 = _parse_prom(cp.metrics.render().decode())
+                sem = asyncio.Semaphore(concurrency)
+                t0 = time.monotonic()
+                await asyncio.gather(
+                    *(one(engine, i, sem, sinks[mode]) for i in range(lo, hi))
+                )
+                elapsed = time.monotonic() - t0
+                prom1 = _parse_prom(cp.metrics.render().decode())
+                a = acc[mode]
+                r_tok = prom1.get(
+                    "mcpx_engine_decode_tokens_total", 0.0
+                ) - prom0.get("mcpx_engine_decode_tokens_total", 0.0)
+                a["tok"] += r_tok
+                a["seg"] += prom1.get(
+                    "mcpx_engine_segments_total", 0.0
+                ) - prom0.get("mcpx_engine_segments_total", 0.0)
+                a["elapsed"] += elapsed
+                a["rounds"].append(
+                    {
+                        "decode_tok_s": round(r_tok / max(1e-9, elapsed), 1),
+                        "plans_per_sec": round(
+                            (hi - lo) / max(1e-9, elapsed), 2
+                        ),
+                    }
+                )
+        kernel_paths = engine.pallas_paths()
+    finally:
+        await engine.aclose()
+
+    # Cadence parity gate: the SAME greedy request byte-identical across
+    # per-step and fused dispatch (mid-window retirement, admission
+    # cadence and done-row idling must never change what a row emits).
+    broken = [i for i in sinks["per_step"] if sinks["fused"].get(i) != sinks["per_step"][i]]
+    if broken:
+        raise BenchGateError(
+            f"fused dispatch changed greedy outputs on {len(broken)}/"
+            f"{len(sinks['per_step'])} requests (fused vs per-step)"
+        )
+
+    # Interpret-parity gate: the ragged kernel's tokens vs the pure-jnp
+    # reference path, end to end through a second dedicated engine. Only
+    # meaningful when the kernel arm actually resolved the kernel route —
+    # under MCPX_BENCH_PALLAS=0 (or a fused-jnp-only smoke artifact) both
+    # engines would serve jnp and the gate would vacuously "pass" while
+    # reading as kernel validation; report None instead and skip the
+    # reference engine's whole serve.
+    interpret_parity: "bool | None" = None
+    if kernel_paths["enabled"]:
+        ref_sink: dict = {}
+        ref_engine = mk_engine(False)
+        await ref_engine.start()
+        try:
+            sem = asyncio.Semaphore(concurrency)
+            await asyncio.gather(
+                *(one(ref_engine, i, sem, ref_sink) for i in range(n))
+            )
+            await _idle(ref_engine)
+        finally:
+            await ref_engine.aclose()
+        diverged = [
+            i for i in sinks["fused"] if ref_sink.get(i) != sinks["fused"][i]
+        ]
+        if diverged:
+            raise BenchGateError(
+                f"ragged kernel diverged from the jnp reference on "
+                f"{len(diverged)}/{len(sinks['fused'])} greedy requests "
+                "(interpret-parity gate)"
+            )
+        interpret_parity = True
+
+    def mode_res(mode: str) -> dict:
+        a = acc[mode]
+        return {
+            "decode_tok_s": max(r["decode_tok_s"] for r in a["rounds"]),
+            "plans_per_sec": max(r["plans_per_sec"] for r in a["rounds"]),
+            "decode_tokens": int(a["tok"]),
+            "segments": int(a["seg"]),
+            # Cadence is deterministic — totals across rounds, not best-of.
+            "dispatches_per_token": round(a["seg"] / max(1.0, a["tok"]), 4),
+            "rounds": a["rounds"],
+        }
+
+    per_step, fused = mode_res("per_step"), mode_res("fused")
+    return {
+        "requests": n,
+        "rounds": ROUNDS,
+        "steps_per_dispatch": fused_k,
+        "fused_window_forwards": base_tick * fused_k,
+        "per_step": per_step,
+        "fused": fused,
+        # The two acceptance numbers, spelled the way the driver greps:
+        # dispatch cadence under the fused window (vs the per-step arm
+        # right next to it) and the wall-clock guard.
+        "decode_dispatches_per_token": fused["dispatches_per_token"],
+        "decode_dispatches_per_token_per_step": per_step["dispatches_per_token"],
+        "dispatch_reduction": round(
+            per_step["dispatches_per_token"]
+            / max(1e-9, fused["dispatches_per_token"]),
+            2,
+        ),
+        "fused_decode_speedup": round(
+            fused["decode_tok_s"] / max(1e-9, per_step["decode_tok_s"]), 3
+        ),
+        # True = gated above (divergence raised); None = kernel arm not
+        # kernel-routed (operator forced jnp), so there was nothing to
+        # validate and no reference engine ran.
+        "interpret_parity": interpret_parity,
+        "cadence_parity": True,  # gated above: divergence raised
+        "pallas_paths": kernel_paths,
+    }
+
+
 async def _run(model_size: str, n_requests: int, concurrency: int, n_services: int) -> dict:
     from aiohttp import ClientSession, TCPConnector
     from aiohttp.test_utils import TestServer
@@ -2299,7 +2555,15 @@ async def _run(model_size: str, n_requests: int, concurrency: int, n_services: i
 
     cfg = _build_config(model_size)
     if not _on_tpu():
-        cfg.engine.use_pallas = False
+        if _pallas_on():
+            # ISSUE 15 headline contract: the CPU proxy serves the ragged
+            # kernel through the Pallas interpreter (same kernel body TPUs
+            # run) instead of silently swapping in the jnp reference —
+            # `pallas=true` now means kernel-on-every-path on BOTH
+            # platforms. MCPX_BENCH_PALLAS=0 restores the jnp proxy.
+            cfg.engine.interpret = True
+        else:
+            cfg.engine.use_pallas = False
     cp = build_control_plane(cfg)
     # MCPX_BENCH_REGISTRY=ood swaps in the disjoint camelCase naming
     # universe (utils/synth.synth_registry_ood) — the registry the BPE
@@ -2518,6 +2782,13 @@ async def _run(model_size: str, n_requests: int, concurrency: int, n_services: i
         # ledger + SLO tracker, all restored in its finally).
         ledger = await _ledger_phase(cp)
 
+        # ---- Phase 12: ragged kernel + fused decode dispatch (ISSUE 15)
+        # — dedicated 1×1 engines (per-step vs fused cadence at the same
+        # offered load, kernel-vs-jnp interpret-parity gate); the serving
+        # engine sits idle, so the shared metric deltas are the kernel
+        # engines' alone.
+        kernel = await _kernel_phase(cp)
+
         # ---- Phase 5: latency attribution (ISSUE 4) — a traced open-loop
         # sample at the phase-2 rate; runs after every headline scrape
         # because attaching the tracer is the one thing this phase does
@@ -2680,6 +2951,11 @@ async def _run(model_size: str, n_requests: int, concurrency: int, n_services: i
         # billing overhead vs the pass-through, per-tenant itemized
         # usage, wall-attribution fraction, FLOP conservation verdict.
         "ledger": ledger,
+        # Ragged kernel + fused dispatch scenario (None when skipped):
+        # per-step vs fused decode dispatch cadence at the same offered
+        # load, dispatch-per-token drop, wall-clock guard, and the
+        # kernel-vs-jnp interpret-parity verdict.
+        "kernel": kernel,
         # Per-phase latency attribution from sampled request traces (None
         # when skipped): p50/p99 of scheduler-queue vs engine admit-wait vs
         # prefill vs decode vs tool fan-out, plus each phase's share of the
@@ -2736,6 +3012,15 @@ async def _run(model_size: str, n_requests: int, concurrency: int, n_services: i
         "pallas_effective": (
             bool(engine._use_pallas)
             if engine is not None and getattr(engine, "_use_pallas", None) is not None
+            else None
+        ),
+        # Per-path engagement (ISSUE 15): decode / suffix-prefill /
+        # spec-verify each report kernel-routed-or-not + dispatch counts
+        # + the blocking reason — a headline `pallas=true` can no longer
+        # mask a single path's jnp fork.
+        "pallas_paths": (
+            engine.pallas_paths()
+            if engine is not None and hasattr(engine, "pallas_paths")
             else None
         ),
         # Plan-cache accounting for repeat-intent runs (hit share over the
@@ -2846,14 +3131,18 @@ def _fallback_kinds(prom: dict[str, float]) -> dict[str, float]:
 
 
 def _pallas_on() -> bool:
-    """Pallas only on TPU; MCPX_BENCH_PALLAS overrides explicitly, else the
-    smoke artifact's proven kernel config applies (a smoke that only served
-    fused-jnp must steer the driver's unattended round-end run too)."""
-    if not _on_tpu():
-        return False
+    """Whether the ragged kernel path serves. MCPX_BENCH_PALLAS overrides
+    explicitly; on TPU the smoke artifact's proven kernel config applies
+    (a smoke that only served fused-jnp must steer the driver's unattended
+    round-end run too); OFF-TPU the kernel serves by default through the
+    Pallas INTERPRETER (ISSUE 15: the CPU proxy runs the same kernel body
+    TPUs run — engine.interpret is set by _run), so the headline `pallas`
+    flag finally means the same thing on both platforms."""
     env = os.environ.get("MCPX_BENCH_PALLAS")
     if env is not None:
         return env != "0"
+    if not _on_tpu():
+        return True
     return bool(_smoke_artifact().get("pallas", True))
 
 
@@ -2863,14 +3152,15 @@ def _pallas_reason(engine_use_pallas: "bool | None" = None) -> str:
     platform, operator override, smoke-artifact evidence, or the engine's
     own hardware probe (``engine_use_pallas`` = the live engine's resolved
     ``_use_pallas``, when available)."""
-    if not _on_tpu():
-        return (
-            "cpu backend: Mosaic TPU kernels cannot run — the fused-jnp "
-            "reference attention serves"
-        )
     env = os.environ.get("MCPX_BENCH_PALLAS")
     if env == "0":
         return "MCPX_BENCH_PALLAS=0: operator forced the fused-jnp path"
+    if not _on_tpu():
+        return (
+            "enabled (interpret): cpu proxy serves the ragged kernel "
+            "through the Pallas interpreter — the same kernel body TPUs "
+            "run; Mosaic lowering itself needs TPU hardware"
+        )
     if env is None and not _smoke_artifact().get("pallas", True):
         return (
             "benchmarks/smoke_tpu.json: the last hardware-proven bring-up "
@@ -3069,6 +3359,12 @@ def _output_json(stats: dict, quality_trained, model: str) -> dict:
                 # Satellite (ISSUE 7): pallas=false is diagnosable from the
                 # JSON alone — platform / override / smoke / engine probe.
                 "pallas_reason": stats.get("pallas_reason") or _pallas_reason(),
+                # Satellite (ISSUE 15): the single boolean above is backed
+                # by PER-PATH engagement (decode / suffix-prefill /
+                # spec-verify, each with dispatch counts and a blocking
+                # reason when jnp-forked) — the block that makes a
+                # headline `pallas=true` unable to mask one path's fork.
+                "pallas_paths": stats.get("pallas_paths"),
                 # Tentpole (ISSUE 7): per-phase XLA roofline + analytic
                 # cross-check; basis labels fall back, never vanish.
                 "roofline": stats.get("roofline")
@@ -3162,6 +3458,23 @@ def _output_json(stats: dict, quality_trained, model: str) -> dict:
                 "worker_profile": (
                     stats["flight"]["worker_profile"]
                     if stats.get("flight") else None
+                ),
+                "kernel": stats.get("kernel"),
+                # Acceptance keys promoted to the top level (ISSUE 15):
+                # fused-dispatch cadence (decode dispatches per token,
+                # with the per-step arm right next to it) and the
+                # wall-clock guard (fused tokens/s over per-step).
+                "decode_dispatches_per_token": (
+                    stats["kernel"]["decode_dispatches_per_token"]
+                    if stats.get("kernel") else None
+                ),
+                "decode_dispatches_per_token_per_step": (
+                    stats["kernel"]["decode_dispatches_per_token_per_step"]
+                    if stats.get("kernel") else None
+                ),
+                "fused_decode_speedup": (
+                    stats["kernel"]["fused_decode_speedup"]
+                    if stats.get("kernel") else None
                 ),
                 "ledger": stats.get("ledger"),
                 # Acceptance keys promoted to the top level (ISSUE 14):

@@ -381,3 +381,90 @@ def check_traced_control_flow(ctx: FileContext) -> Iterator[Finding]:
                         f"scope '{fn.name}' — use lax.cond/lax.select or "
                         "jnp.where on device values",
                     )
+
+
+# Kernel-route flags a call site may hardcode past the engine's resolved
+# verdict. ``use_pallas`` picks kernel-vs-jnp; ``interpret`` picks the
+# Mosaic-vs-interpreter lowering — literals for either at a call site that
+# has a resolved flag in scope silently fork one serving path off the
+# route every other path takes.
+_KERNEL_FLAG_KWARGS = ("use_pallas", "interpret")
+_RESOLVED_FLAG_ATTRS = {"_use_pallas"}
+
+
+def _class_has_resolved_flag(cls: ast.ClassDef) -> bool:
+    """True when any method of ``cls`` reads or writes a resolved kernel
+    flag attribute (``self._use_pallas``) — the class then owns an
+    engine-resolved route that call-site literals would override."""
+    for node in ast.walk(cls):
+        if (
+            isinstance(node, ast.Attribute)
+            and node.attr in _RESOLVED_FLAG_ATTRS
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+        ):
+            return True
+    return False
+
+
+@rule(
+    "hardcoded-kernel-fallback",
+    "use_pallas=/interpret= literal at a call site with an engine-resolved "
+    "kernel flag in scope",
+)
+def check_hardcoded_kernel_fallback(ctx: FileContext) -> Iterator[Finding]:
+    """A ``use_pallas=False`` (or literal ``interpret=``) keyword at a call
+    site whose enclosing class resolves the kernel route itself
+    (``self._use_pallas``) — or whose enclosing function RECEIVES the
+    resolved flag as a ``use_pallas`` parameter — forks that one path off
+    the kernel while the headline flag still reads true. This is the bug
+    class the engine's suffix-prefill carried for seven PRs: every other
+    dispatch honored the resolved flag, this one call site pinned
+    ``use_pallas=False``, and the jnp fork was invisible until the
+    per-path engagement report (ISSUE 15). Literals in classes/functions
+    WITHOUT a resolved flag in scope (tests, reference harnesses, the
+    default in a signature) stay silent — they are not overriding a
+    resolution, they are the configuration."""
+    tree = ctx.tree
+
+    def flag_calls(scope: ast.AST) -> Iterator[tuple[ast.Call, str]]:
+        for node in ast.walk(scope):
+            if not isinstance(node, ast.Call):
+                continue
+            for kw in node.keywords:
+                if kw.arg in _KERNEL_FLAG_KWARGS and isinstance(
+                    kw.value, ast.Constant
+                ):
+                    yield node, kw.arg
+
+    seen: set[tuple[int, str]] = set()
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef) or not _class_has_resolved_flag(cls):
+            continue
+        for call, arg in flag_calls(cls):
+            if (call.lineno, arg) not in seen:
+                seen.add((call.lineno, arg))
+                yield ctx.finding(
+                    call.lineno,
+                    "hardcoded-kernel-fallback",
+                    f"literal '{arg}=' at a call site inside "
+                    f"'{cls.name}', which resolves the kernel route "
+                    "itself (self._use_pallas) — pass the resolved flag "
+                    "so this path cannot silently fork off the kernel",
+                )
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        params = {a.arg for a in fn.args.args + fn.args.kwonlyargs}
+        if "use_pallas" not in params:
+            continue
+        for call, arg in flag_calls(fn):
+            if arg == "use_pallas" and (call.lineno, arg) not in seen:
+                seen.add((call.lineno, arg))
+                yield ctx.finding(
+                    call.lineno,
+                    "hardcoded-kernel-fallback",
+                    f"literal 'use_pallas=' inside '{fn.name}', which "
+                    "already receives the resolved flag as a parameter — "
+                    "pass it through instead of pinning one route",
+                )

@@ -59,6 +59,8 @@ TRACKED_METRICS: tuple[tuple[str, str, Optional[str]], ...] = (
     ("replan_warm_sat_p50_ms", "lower", None),
     ("flight_overhead_frac", "lower", None),
     ("ledger_overhead_frac", "lower", None),
+    ("decode_dispatches_per_token", "lower", None),
+    ("fused_decode_speedup", "higher", None),
     ("attribution.wall_attributed_frac", "higher", None),
     ("tier_token_hit_rate", "higher", None),
     ("tier_hit_ratio", "higher", None),

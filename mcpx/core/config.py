@@ -157,6 +157,25 @@ class EngineConfig:
     # weight-bound forwards) roughly covers one host<->device round trip:
     # the pipelined worker overlaps the flag fetch with the next segment.
     decode_steps_per_tick: int = 4
+    # Fused multi-step decode dispatch (ISSUE 15): how many decode
+    # iterations fold into ONE jitted dispatch — the dispatched window
+    # runs decode_steps_per_tick * steps_per_dispatch model forwards
+    # in-graph (one executable; per-row done masks are DATA, so finished
+    # rows idle safely and the loop still exits early when the whole slab
+    # drains). The r07 worker profile measured XLA dispatch at ~80% of
+    # the engine worker's wall: host-side bookkeeping (harvest, admission,
+    # gauge publish) then runs once per fused window instead of once per
+    # tick, amortising exactly that line. 1 = per-step-window legacy
+    # cadence (bench phase 12's baseline arm). Tradeoff: a new arrival
+    # waits up to one fused window for admission, and retirement lags by
+    # pipeline_depth-1 windows — size the product against your admission-
+    # latency budget (docs/engine.md "Ragged kernel & fused decode
+    # dispatch"). The speculative segment is NOT multiplied: its
+    # iterations are unrolled without early exit (pool-aliasing
+    # constraint) and each already amortises dispatch over a [rows, K+1]
+    # window, so a longer unroll would pay full verify compute on the
+    # drain tail for nothing.
+    steps_per_dispatch: int = 4
     # Decode segments kept in flight before the worker blocks on the oldest
     # one's done-flags. 1 = fetch the segment just dispatched (no overlap).
     # 2 = fetch the PREVIOUS segment's flags while the current one computes,
@@ -788,6 +807,12 @@ class MCPXConfig:
             )
         if self.engine.decode_steps_per_tick < 1:
             problems.append("engine.decode_steps_per_tick must be >= 1")
+        if not 1 <= self.engine.steps_per_dispatch <= 64:
+            # The fused window multiplies the while-loop segment's iters
+            # static; 64 windows of the default 4-forward tick is already
+            # a 256-forward dispatch — past any plausible admission-latency
+            # budget, and a typo guard for ms-vs-count confusions.
+            problems.append("engine.steps_per_dispatch must be in [1, 64]")
         if not 0.0 < self.telemetry.ewma_alpha <= 1.0:
             problems.append("telemetry.ewma_alpha must be in (0, 1]")
         fl = self.telemetry.flight

@@ -407,6 +407,22 @@ class InferenceEngine:
         )
         self.grammar: PlanGrammar = build_plan_grammar(self.tokenizer)
         self.metrics = metrics or Metrics()
+        # Resolved kernel route, decided from config + model geometry alone
+        # so a COLD engine can already answer pallas_paths()/queue_stats():
+        # Mosaic tiles the last (lane) dim at 128, so head dims that don't
+        # align can't use the Pallas kernel on hardware — fall back to the
+        # fused-jnp paged attention (interpret mode has no such constraint).
+        self._use_pallas = ecfg.use_pallas and (
+            ecfg.interpret or self.model_cfg.head_dim % 128 == 0
+        )
+        # Per-path kernel dispatch counters (decode / suffix-prefill /
+        # spec-verify): how often each serving path actually ran, next to
+        # the per-path engagement flags in pallas_paths() — a headline
+        # `pallas=true` can then never mask a jnp fork OR an idle path.
+        # Worker-thread writes, GIL-atomic cross-thread reads.
+        self._pallas_dispatches = {  # mcpx: owner[engine-worker, atomic]
+            "decode": 0, "prefill": 0, "spec_verify": 0,
+        }
         self.state = "cold"
         self._state_lock = threading.Lock()
         self._mesh = mesh
@@ -875,6 +891,60 @@ class InferenceEngine:
             out["governor"] = self._governor.stats(self._prefix_cache.max_tokens)
         return out
 
+    def pallas_paths(self) -> dict:
+        """Per-path kernel engagement — the honest replacement for the old
+        single ``pallas`` boolean (a true flag used to coexist with the
+        suffix-prefill path silently forking to jnp for seven PRs). Each
+        serving path that dispatches paged attention reports whether IT
+        routes through the ragged kernel (``engaged``) and how many times
+        it has actually run (``dispatches``); ``reason`` names the
+        blocking condition when a path is NOT kernel-routed, or why an
+        engaged path is idle (subsystem off) — absence of a reason means
+        kernel-routed and armed. Cold-engine safe: the route is resolved
+        at __init__ from config + model geometry, and the counters are
+        GIL-atomic ints."""
+        ecfg = self.config.engine
+        on = bool(self._use_pallas)
+        if not ecfg.use_pallas:
+            blocked = "engine.use_pallas=false (config)"
+        elif not on:
+            blocked = (
+                f"head_dim {self.model_cfg.head_dim} % 128 != 0: Mosaic "
+                "lane tiling rejects the kernel on hardware "
+                "(engine.interpret=true lifts the constraint off-TPU)"
+            )
+        else:
+            blocked = None
+        d = self._pallas_dispatches
+
+        def path(name: str, idle: Optional[str]) -> dict:
+            return {
+                "engaged": on,
+                "dispatches": d[name],
+                "reason": blocked if not on else idle,
+            }
+
+        return {
+            "enabled": on,
+            "interpret": bool(ecfg.interpret),
+            "reason": blocked,
+            "paths": {
+                "decode": path("decode", None),
+                "prefill": path(
+                    "prefill",
+                    None
+                    if ecfg.prefix_cache
+                    else "idle: prefix_cache=off (no suffix prefills)",
+                ),
+                "spec_verify": path(
+                    "spec_verify",
+                    None
+                    if self._spec_k() > 0
+                    else "idle: speculative decoding off",
+                ),
+            },
+        }
+
     def queue_stats(self) -> dict:
         """Cross-thread snapshot of engine load for the serving scheduler
         (mcpx/scheduler/): how many requests wait unadmitted, how many slab
@@ -921,6 +991,12 @@ class InferenceEngine:
         extra = {"worker_profile": prof.snapshot()} if prof is not None else {}
         return {
             **extra,
+            # Per-path ragged-kernel engagement (decode / suffix-prefill /
+            # spec-verify): route + dispatch counts + blocking reason, so
+            # the scheduler, /healthz watchers and the bench headline all
+            # read the SAME per-path truth (ISSUE 15 satellite — a single
+            # boolean used to mask the suffix-prefill jnp fork).
+            "pallas": self.pallas_paths(),
             "prefix_nodes": ps_pfx["nodes"],
             "prefix_resident_pages": ps_pfx["resident_pages"],
             "prefix_hit_rate": ps_pfx["hit_rate"],
@@ -1017,12 +1093,9 @@ class InferenceEngine:
                 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
             except Exception as e:  # noqa: BLE001 - cache is an optimisation
                 log.warning("persistent compilation cache unavailable: %s", e)
-        # Mosaic tiles the last (lane) dim at 128: head dims that don't align
-        # can't use the Pallas kernel on hardware — fall back to the fused-jnp
-        # paged attention (interpret mode has no such constraint).
-        self._use_pallas = ecfg.use_pallas and (
-            ecfg.interpret or self.model_cfg.head_dim % 128 == 0
-        )
+        # _use_pallas was resolved in __init__ (config + head-dim probe) so
+        # the cold-engine observability surfaces could already report it;
+        # nothing at setup time changes the verdict.
         if self._mesh is None:
             data_axis, model_axis = self._mesh_axes(len(jax.devices()))
             self._mesh = make_mesh(data=data_axis, model=model_axis)
@@ -1440,7 +1513,7 @@ class InferenceEngine:
             )
         slab = self._slab
         chunk = self._spec_chunk(True)
-        iters = max(1, ecfg.decode_steps_per_tick)
+        iters = self._decode_iters(spec=False)
         rs_b = self._row_spec(slab.B)
         rs_b2 = self._row_spec(slab.B, 1)
         if ecfg.hetero_batch:
@@ -1479,7 +1552,7 @@ class InferenceEngine:
                         (slab.hstate, rs_b2),
                     ),
                     key,
-                    iters=iters,
+                    iters=self._decode_iters(spec=True),
                     K=self._spec_k(),
                     draft=ecfg.speculative.draft,
                 )
@@ -1901,6 +1974,27 @@ class InferenceEngine:
             return 64
         return budget
 
+    def _decode_iters(self, spec: bool) -> int:
+        """Model-forward iterations per dispatched decode executable — the
+        FUSED MULTI-STEP WINDOW: ``decode_steps_per_tick`` (the legacy
+        tick) times ``steps_per_dispatch`` folded into one jitted
+        ``lax.while_loop`` whose per-row done masks are data, so one host
+        dispatch + one harvest serve the whole window (the r07 profiler's
+        ~80%-dispatch line, amortised). The while loop exits early when
+        every row drains, so a long window never burns device compute —
+        only admission latency, which is the knob's documented tradeoff.
+        The SPECULATIVE segment is excluded: its iterations are unrolled
+        without early exit (pool-aliasing constraint, see
+        ``_hetero_segment_spec_impl``) and each already covers a
+        [rows, K+1] window, so multiplying it would pay full verify
+        compute on the drain tail. Shared by warmup and dispatch so the
+        warmed executable is exactly the served one."""
+        ecfg = self.config.engine
+        base = max(1, ecfg.decode_steps_per_tick)
+        if spec:
+            return base
+        return base * max(1, ecfg.steps_per_dispatch)
+
     def _spec_chunk(self, constrained: bool) -> int:
         """Static speculation chunk width — config-derived only (it is a jit
         static arg: one executable shared by warmup and every segment). On
@@ -2131,10 +2225,13 @@ class InferenceEngine:
         prefix's read-only pages plus themselves (intra-chunk causal) —
         ``decode_chunk_paged``'s existing contract, at prefill width. Pads
         past a row's suffix write garbage K/V at positions its decode later
-        overwrites (or the null page); their logits are never read. Uses the
-        fused-jnp chunk attention: the Pallas kernel is tiled for
-        speculation-width chunks, and prefill-width attention is a small
-        fraction of the suffix matmuls anyway."""
+        overwrites (or the null page); their logits are never read. Routes
+        through the ragged kernel on the engine-resolved ``_use_pallas``
+        (the hardcoded ``use_pallas=False`` fork this call site carried for
+        seven PRs is the bug class mcpxlint's ``hardcoded-kernel-fallback``
+        rule now polices): per-row suffix lengths are the kernel's
+        ``q_lens``, so short-suffix rows (warm replans prefilling ~1 page)
+        stream pages for their own width, not the cohort bucket's."""
         cfg = self.model_cfg
         last, kv = decode_chunk_paged(
             params,
@@ -2143,9 +2240,10 @@ class InferenceEngine:
             positions,
             page_table,
             {"k": paged_k, "v": paged_v},
-            use_pallas=False,
+            use_pallas=self._use_pallas,
             interpret=self.config.engine.interpret,
             logits_at=seq_lens - 1,  # [A, V]: suffix-final logits only
+            q_lens=seq_lens,
         )
         return last, kv["k"], kv["v"]
 
@@ -2493,6 +2591,12 @@ class InferenceEngine:
                     self._paged_kv["k"],
                     self._paged_kv["v"],
                 )
+                # Every suffix-prefill dispatch counts toward the
+                # prefill path's engagement report, not just the
+                # admission-cohort site — a server whose only suffix
+                # prefills are pre-built heads must not read as
+                # "engaged but never ran" (pallas_paths).
+                self._pallas_dispatches["prefill"] += 1
             else:
                 # Long shared prefixes are the prime ring workload — route
                 # them like any full prefill (B=1 rides the seq mesh's
@@ -2671,7 +2775,10 @@ class InferenceEngine:
             s_before = jnp.moveaxis(s_before, 0, 1)  # [B, J]
 
             # --- one forward over [cur, proposals], compact logits at
-            # EVERY chunk position (verification needs them all).
+            # EVERY chunk position (verification needs them all). Ragged:
+            # each row's live window is cur + its own proposal chain
+            # (p_use is a prefix mask), so the kernel streams pages for
+            # what the row actually proposed, not the static chunk width.
             chunk_toks = jnp.concatenate([cur[:, None], p_toks], axis=1)
             logits_c, kv = decode_chunk_paged(
                 params,
@@ -2683,6 +2790,9 @@ class InferenceEngine:
                 use_pallas=self._use_pallas,
                 interpret=self.config.engine.interpret,
                 active_cols=dfa_active,
+                q_lens=jnp.where(
+                    done, 0, 1 + jnp.sum(p_use, axis=1).astype(jnp.int32)
+                ),
             )  # [B, chunk, C] float32
 
             # --- verify: accepted prefix = positions where the proposal IS
@@ -2793,7 +2903,10 @@ class InferenceEngine:
             # One chunked forward consumes [cur, forced...]; pad slots past
             # a row's chain write garbage K/V that the next chunk overwrites
             # (decode_chunk_paged contract); done/free rows write to the
-            # null page via their zeroed page-table rows.
+            # null page via their zeroed page-table rows. ``adv`` doubles
+            # as the ragged q_lens: each row's live window is its own
+            # consumed chain (0 for done rows — they idle through the
+            # fused window at zero attention cost).
             adv = jnp.where(done, 0, 1) + adv_extra  # tokens consumed
             last_logits, kv = decode_chunk_paged(
                 params,
@@ -2805,6 +2918,7 @@ class InferenceEngine:
                 use_pallas=self._use_pallas,
                 interpret=self.config.engine.interpret,
                 logits_at=jnp.maximum(adv - 1, 0),  # [B, V]: chain-end only
+                q_lens=adv,
             )
 
             key, sub = jax.random.split(key)
@@ -2971,6 +3085,9 @@ class InferenceEngine:
                 chunk_toks = cur[:, None]
                 adv_extra = 0
 
+            # adv doubles as the ragged q_lens (done rows idle at zero
+            # attention cost through the fused window), like the
+            # homogeneous segment above.
             adv = jnp.where(done, 0, 1) + adv_extra
             last_logits, kv = decode_chunk_paged(
                 params,
@@ -2982,6 +3099,7 @@ class InferenceEngine:
                 use_pallas=self._use_pallas,
                 interpret=self.config.engine.interpret,
                 logits_at=jnp.maximum(adv - 1, 0),  # [B, V]: chain-end only
+                q_lens=adv,
             )
 
             key, sub = jax.random.split(key)
@@ -3156,6 +3274,11 @@ class InferenceEngine:
             )
 
             # --- 2. ONE verify forward over the fixed [B, K+1] window.
+            # The window SHAPE is fixed (one executable per K), but the
+            # rows are ragged DATA: each verifies cur + its own drafted
+            # prefix (p_use is a prefix mask), so a row that drafted 2 of
+            # K=8 streams pages for 3 positions and a done row for none —
+            # the spec-verify path of the ragged kernel.
             window = jnp.concatenate([cur[:, None], p_toks], axis=1)
             logits_w, kv = decode_chunk_paged(
                 params,
@@ -3166,6 +3289,9 @@ class InferenceEngine:
                 {"k": k_p, "v": v_p},
                 use_pallas=self._use_pallas,
                 interpret=self.config.engine.interpret,
+                q_lens=jnp.where(
+                    done, 0, 1 + jnp.sum(p_use, axis=1).astype(jnp.int32)
+                ),
             )  # [B, K+1, V] float32
 
             # Per-position verification samples: position j is masked at
@@ -3336,7 +3462,12 @@ class InferenceEngine:
                     # device is already computing.
                     self._dispatch_segment(slab)
                     if prof is not None:
-                        prof.lap("dispatch")
+                        # Submit only — the async XLA enqueue's host cost.
+                        # Blocking device waits show up as the "sync"
+                        # carve inside harvest, so the fused-dispatch win
+                        # (submit down) is attributable separately from
+                        # "the device is now the bottleneck" (sync up).
+                        prof.lap("dispatch_submit")
                     self._harvest(
                         slab,
                         keep_inflight=max(0, self.config.engine.pipeline_depth - 1),
@@ -4045,6 +4176,7 @@ class InferenceEngine:
                 )
                 pf_entry = getattr(self._jit_suffix_prefill, "last_entry", None)
                 pf_name = "suffix_prefill"
+                self._pallas_dispatches["prefill"] += 1
             else:
                 (
                     tokens_d, lens_d, table_d, budgets_d, active_d,
@@ -4300,9 +4432,19 @@ class InferenceEngine:
         ecfg = self.config.engine
         hetero = slab.hetero
         chunk = self._spec_chunk(True if hetero else slab.constrained)
-        iters = max(1, ecfg.decode_steps_per_tick)
+        # Fused multi-step window: one dispatch covers steps_per_dispatch
+        # ticks of decode (host bookkeeping runs once per window); the
+        # spec segment keeps its own per-tick iteration count (see
+        # _decode_iters for both rationales).
+        iters = self._decode_iters(spec=hetero and slab.spec)
         self.metrics.segments.inc()
         self.metrics.segment_active_rows.inc(slab.n_active)
+        # Per-path kernel accounting (pallas_paths): every segment is a
+        # decode-path dispatch; the spec segment is ALSO a spec-verify
+        # dispatch (its verify forward rides the same executable).
+        self._pallas_dispatches["decode"] += 1
+        if hetero and slab.spec:
+            self._pallas_dispatches["spec_verify"] += 1
         self._seg_counter += 1
         (
             cur_d, pos_d, st_d, e_d, done_d, budgets_d, pt_d, buf_in,
@@ -4483,15 +4625,23 @@ class InferenceEngine:
             # round trip (~72ms), not the ~24KB of buffer — splitting into
             # flags-then-buf would add a second round trip on every
             # retirement tick, which at steady state is most ticks. The
-            # speculation counters ([B] ints) ride the same fetch.
+            # speculation counters ([B] ints) ride the same fetch. The
+            # blocking wait is carved out as the profiler's "sync" phase:
+            # time spent waiting for device compute, not host bookkeeping
+            # (the harvest lap keeps only the latter).
+            prof = self._profiler
+            t_sync = prof.mark() if prof is not None else 0.0
             dr = ac = None
             if spec_h is not None:
                 done, e, buf, n_fwd, dr, ac = jax.device_get(
                     (done_d, e_d, buf_d, nfwd_d) + spec_h
                 )
-                self._account_speculation(dr, ac, cons_snap)
             else:
                 done, e, buf, n_fwd = jax.device_get((done_d, e_d, buf_d, nfwd_d))
+            if prof is not None:
+                prof.carve("sync", t_sync)
+            if dr is not None:
+                self._account_speculation(dr, ac, cons_snap)
             # The blocking fetch above implies every earlier admission chain
             # has executed — resolve their timings before retiring rows that
             # may have finished in their very first segment.

@@ -1,0 +1,7 @@
+"""Accelerator kernels for the serving engine (see README.md in this
+package for the layout, raggedness and parity contracts).
+
+``paged_attention`` exports the ragged mixed-phase paged-attention kernel
+(Pallas TPU, interpret-mode CPU path) plus the pure-jnp references the
+parity tests pin it against.
+"""

@@ -1,22 +1,39 @@
-"""Ragged paged-attention decode kernel (Pallas TPU) + jnp reference.
+"""Ragged mixed-phase paged-attention kernel (Pallas TPU) + jnp reference.
 
-One decode step of attention for a batch of sequences whose KV lives in a
+ONE kernel serves every attention shape the engine dispatches against the
 shared page pool (``mcpx.engine.kv_cache`` layout: kv-head-major, all
 layers in one array — ``[K, L, N_pages, page_size, head_dim]``; the kernel
 streams one layer's slice selected by a prefetched scalar, so the decode
-loop can carry the pools through ``lax.scan``). Grid is ``(B, K)``; each
-program DMAs its sequence's pages HBM→VMEM one at a time and accumulates
-flash-style (online softmax in fp32), so
+loop can carry the pools through ``lax.scan``). The batch is a RAGGED slab
+(see README.md in this package): row ``b`` holds ``q_lens[b]`` live
+queries of the padded ``[B, S_max, ...]`` window —
+
+  - **suffix-prefill rows**: ``S_i`` new tokens attending the resident
+    prefix pages plus themselves (intra-chunk causal),
+  - **plain decode rows**: ``S = 1``,
+  - **speculative verify rows**: a ``[K+1]`` draft window,
+  - **idle rows** (done / cohort padding): ``q_lens[b] == 0`` — the
+    program streams zero pages and writes zeros.
+
+Per-row ``q_len`` / ``start_pos`` / page tables are scalar-prefetched
+DATA, so one compiled launch serves any prefill/decode/spec mix — compile
+count is a function of the padded window shape alone (the Ragged Paged
+Attention design, PAPERS.md). Grid is ``(B, K)``; each program DMAs its
+row's pages HBM→VMEM one at a time and accumulates flash-style (online
+softmax in fp32), so
   - no ``[B, S_max]`` dense cache is ever materialised (ragged batches share
     the pool — the RPA paper's point, PAPERS.md),
+  - a row streams only ``cdiv(start + q_len, page_size)`` pages — a decode
+    row pays decode traffic even when batched next to a prefill row,
   - per-page tiles are ``[page_size, head_dim]`` — contiguous,
     lane-aligned (head_dim multiple of 128), no in-kernel transposes,
-  - arithmetic is ``q [G, hd] @ k.T -> [G, page_size]`` then
-    ``p @ v -> [G, hd]``: MXU matmuls with GQA group size G rows.
+  - arithmetic is ``q [S*G, hd] @ k.T -> [S*G, page_size]`` then
+    ``p @ v -> [S*G, hd]``: MXU matmuls with GQA group size G rows.
 
 The jnp reference implements identical semantics by gathering pages; kernel
 tests assert exact agreement in interpret mode on CPU (SURVEY.md §4.2) and
-on real TPU in the benchmark harness.
+on real TPU in the benchmark harness — tier-1 exercises the same kernel
+body TPUs run.
 """
 
 from __future__ import annotations
@@ -91,11 +108,51 @@ def paged_attention_chunk_reference(
     return out.astype(q.dtype)
 
 
+def ragged_paged_attention_reference(
+    q: jax.Array,  # [B, S, K, G, hd] — padded query windows
+    k_pages: jax.Array,  # [K, L, N, Psz, hd] — all layers
+    v_pages: jax.Array,
+    page_table: jax.Array,  # [B, Pmax] int32
+    start_pos: jax.Array,  # [B] int32 — cache position of query 0
+    q_lens: jax.Array,  # [B] int32 — live queries per row (0 = idle row)
+    layer: jax.Array | int = 0,
+) -> jax.Array:
+    """Ragged mixed-phase semantics, pure jnp: row ``b``'s queries at
+    window index ``i < q_lens[b]`` attend through cache position
+    ``start_pos[b] + i`` (the chunk contract); queries at ``i >= q_lens[b]``
+    are pads and output exactly ZERO — the kernel's idle-row/pad contract,
+    pinned here so the interpret-parity tests cover pads too, not just the
+    positions the callers happen to read. Returns [B, S, K, G, hd]."""
+    out = paged_attention_chunk_reference(
+        q, k_pages, v_pages, page_table, start_pos, layer
+    )
+    valid = jnp.arange(q.shape[1])[None, :] < q_lens[:, None]  # [B, S]
+    return jnp.where(valid[:, :, None, None, None], out, 0).astype(q.dtype)
+
+
 # ------------------------------------------------------------------- kernel
-def _chunk_kernel(
+def _ragged_n_pages(start, qn, page_size: int, p_max: int):
+    """Pages a row streams: through its LAST LIVE query's visible position
+    (``start + qn``), clamped to the table width (a finished row's frozen
+    start + window may overhang its allocation — the caller reserves slack
+    for the garbage writes, but the table has no column past ``p_max``).
+    An idle row (``qn == 0``) streams EXACTLY ZERO pages — without the
+    gate it would still DMA its whole frozen history (``cdiv(start,
+    psz)`` pages of dead traffic per kv-head per layer per forward, and
+    done rows ride many forwards under the fused dispatch window).
+    Factored out of the kernel so the zero-page idle contract is directly
+    unit-testable — from the outputs alone, streamed-then-masked and
+    never-streamed are indistinguishable (that indistinguishability is
+    the masking's correctness argument)."""
+    n = jnp.minimum(pl.cdiv(start + qn, page_size), p_max)
+    return jnp.where(qn > 0, n, 0)
+
+
+def _ragged_kernel(
     # scalar prefetch
     page_table_ref,  # [B, Pmax] SMEM
     start_pos_ref,  # [B] SMEM
+    q_lens_ref,  # [B] SMEM — live queries per row (ragged; 0 = idle row)
     layer_ref,  # [1] SMEM — which layer's pool slice to stream
     # blocks
     q_ref,  # [1, S, 1, G, hd] VMEM
@@ -116,17 +173,20 @@ def _chunk_kernel(
     layer = layer_ref[0]
     S, G, hd = q_ref.shape[1], q_ref.shape[3], q_ref.shape[4]
     start = start_pos_ref[b]
-    # The last chunk query attends through position start+S-1, so every page
-    # up to that position must stream in; earlier queries mask the tail.
-    # Clamped to the table width: a finished row's frozen start + S may
-    # overhang its allocation by up to the chunk width (the caller reserves
-    # slack for the garbage writes, but the table has no column past Pmax).
-    n_pages = jnp.minimum(pl.cdiv(start + S, page_size), page_table_ref.shape[1])
+    qn = q_lens_ref[b]
+    # The row's LAST LIVE query attends through position start+qn-1, so
+    # only pages up to that position stream in — a decode row (qn=1) next
+    # to a prefill row (qn=S) pays decode-sized page traffic, and an idle
+    # row (qn=0) streams nothing (see _ragged_n_pages) and falls through
+    # to the zero output.
+    n_pages = _ragged_n_pages(start, qn, page_size, page_table_ref.shape[1])
 
     q = q_ref[0, :, 0].reshape(S * G, hd).astype(jnp.float32)
     scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
-    # Visible length per q row r (row r is query r//G): start + r//G + 1.
+    # Visible length per q row r (row r is query r//G): start + r//G + 1;
+    # pad queries (r//G >= qn) see nothing and zero out below.
     row_q = lax.broadcasted_iota(jnp.int32, (S * G, 1), 0) // G
+    q_valid = row_q < qn  # [S*G, 1]
     vis = start + row_q + 1  # [S*G, 1]
 
     def dma_k(slot, page_idx):
@@ -166,11 +226,16 @@ def _chunk_kernel(
         )  # [S*G, Psz]
         s = s * scale
         pos = i * page_size + lax.broadcasted_iota(jnp.int32, (1, page_size), 1)
-        s = jnp.where(pos < vis, s, NEG_INF)
+        s = jnp.where(q_valid & (pos < vis), s, NEG_INF)
 
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new)  # [S*G, Psz]
+        # Fully-masked rows (pad queries of a live row) keep m_new at
+        # NEG_INF, where exp(s - m_new) would be exp(0) = 1 — guard so
+        # their weights stay exactly 0 and the l == 0 fallthrough below
+        # emits the reference's zeros (live queries always see page 0's
+        # position 0, so the guard never fires for them).
+        p = jnp.where(s <= NEG_INF * 0.5, 0.0, jnp.exp(s - m_new))  # [S*G, Psz]
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc_new = acc * alpha + lax.dot_general(
             p, v_tile, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -193,29 +258,34 @@ def _chunk_kernel(
 
 
 @functools.partial(jax.jit, static_argnames=("interpret", "n_buf"))
-def paged_attention_chunk(
-    q: jax.Array,  # [B, S, K, G, hd]
+def ragged_paged_attention(
+    q: jax.Array,  # [B, S, K, G, hd] — padded query windows
     k_pages: jax.Array,  # [K, L, N, Psz, hd] — all layers (stays in HBM)
     v_pages: jax.Array,
     page_table: jax.Array,  # [B, Pmax]
     start_pos: jax.Array,  # [B] — cache position of query 0
+    q_lens: jax.Array,  # [B] — live queries per row (0 = idle row)
     layer: jax.Array | int = 0,
     *,
     interpret: bool = False,
     n_buf: int = 4,
 ) -> jax.Array:
-    """Chunked-decode Pallas kernel: grid (B, K); ONE program streams a
-    sequence's pages once for all S chunk queries ([S*G, hd] MXU rows/page
-    vs [G, hd] for the single-query kernel folded over B*S programs — S
-    times fewer DMA issues, S*G-row matmuls instead of G-row). The pools
-    hold every layer ([K, L, ...]) so the decode loop can carry them
-    through lax.scan and the kernel streams just ``layer``'s slice —
-    slicing host-side would materialise a per-layer copy."""
+    """The ragged mixed-phase kernel: grid (B, K); ONE program streams a
+    row's pages once for all of its live queries ([S*G, hd] MXU rows/page
+    vs [G, hd] for a single-query kernel folded over B*S programs — S
+    times fewer DMA issues, S*G-row matmuls instead of G-row). Row
+    raggedness (``q_lens``) is scalar-prefetched DATA like the start
+    offsets and page tables, so suffix-prefill, plain-decode and
+    spec-verify rows share ONE launch of ONE executable per padded window
+    shape — compile count is independent of the phase mix. The pools hold
+    every layer ([K, L, ...]) so the decode loop can carry them through
+    lax.scan and the kernel streams just ``layer``'s slice — slicing
+    host-side would materialise a per-layer copy."""
     B, S, K, G, hd = q.shape
     _, _, _, page_size, _ = k_pages.shape
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
+        num_scalar_prefetch=4,
         grid=(B, K),
         in_specs=[
             pl.BlockSpec(
@@ -234,7 +304,7 @@ def paged_attention_chunk(
             pltpu.SemaphoreType.DMA((n_buf,)),
         ],
     )
-    kernel = functools.partial(_chunk_kernel, page_size=page_size, n_buf=n_buf)
+    kernel = functools.partial(_ragged_kernel, page_size=page_size, n_buf=n_buf)
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -243,10 +313,39 @@ def paged_attention_chunk(
     )(
         page_table.astype(jnp.int32),
         start_pos.astype(jnp.int32),
+        q_lens.astype(jnp.int32),
         jnp.asarray(layer, jnp.int32).reshape(1),
         q,
         k_pages,
         v_pages,
+    )
+
+
+def paged_attention_chunk(
+    q: jax.Array,  # [B, S, K, G, hd]
+    k_pages: jax.Array,  # [K, L, N, Psz, hd] — all layers
+    v_pages: jax.Array,
+    page_table: jax.Array,  # [B, Pmax]
+    start_pos: jax.Array,  # [B] — cache position of query 0
+    layer: jax.Array | int = 0,
+    *,
+    interpret: bool = False,
+    n_buf: int = 4,
+) -> jax.Array:
+    """Dense-window chunk attention: the ``q_lens = S`` specialisation of
+    ``ragged_paged_attention`` (every window position live — the pre-ragged
+    contract, kept for callers whose pads are never read)."""
+    B, S = q.shape[0], q.shape[1]
+    return ragged_paged_attention(
+        q,
+        k_pages,
+        v_pages,
+        page_table,
+        start_pos,
+        jnp.full((B,), S, jnp.int32),
+        layer,
+        interpret=interpret,
+        n_buf=n_buf,
     )
 
 

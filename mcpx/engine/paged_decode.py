@@ -20,6 +20,8 @@ from jax import lax
 from mcpx.engine.kernels.paged_attention import (
     paged_attention_chunk,
     paged_attention_chunk_reference,
+    ragged_paged_attention,
+    ragged_paged_attention_reference,
 )
 from mcpx.models.gemma.config import GemmaConfig
 from mcpx.models.gemma.model import apply_rope, rms_norm
@@ -37,6 +39,7 @@ def decode_chunk_paged(
     interpret: bool = False,
     logits_at: "jax.Array | None" = None,  # [B] chunk slot per row, or None
     active_cols: "jax.Array | None" = None,  # [C] token ids: compact unembed
+    q_lens: "jax.Array | None" = None,  # [B] live window slots (ragged rows)
 ) -> tuple[jax.Array, dict[str, jax.Array]]:
     """Multi-token decode step: S new tokens per sequence in ONE forward.
 
@@ -53,8 +56,17 @@ def decode_chunk_paged(
     Tokens past a sequence's valid chain are pads; their K/V slots hold
     garbage that the next chunk (which starts at the first invalid
     position) overwrites, and their logits are ignored by the caller.
-    Returns ([B, S, V] logits, pools) — or ([B, V], pools) when
-    ``logits_at`` names the single chunk slot per row to unembed.
+    ``q_lens`` makes the raggedness explicit: with per-row live window
+    widths the attention (kernel AND jnp reference, in lockstep) streams
+    only each row's own pages and zeroes pad-query outputs — suffix
+    prefill, plain decode and spec-verify rows share one executable whose
+    compile key is the padded window shape alone. None keeps the dense
+    pre-ragged contract (every slot computed, pads garbage-but-unread);
+    either way the logits callers read are bit-identical, because a pad
+    slot's cache position lies strictly past every live query's visible
+    range at every layer. Returns ([B, S, V] logits, pools) — or
+    ([B, V], pools) when ``logits_at`` names the single chunk slot per
+    row to unembed.
     """
     B, S = tokens.shape
     K, L, N, psz, hd = paged_kv["k"].shape
@@ -78,11 +90,25 @@ def decode_chunk_paged(
     def attend(q, k_all, v_all, layer):
         # Both paths stream/gather each sequence's pages ONCE for all S
         # chunk queries (folding the chunk into the batch dim instead would
-        # multiply page traffic by S — the dominant decode cost).
+        # multiply page traffic by S — the dominant decode cost), and the
+        # kernel and jnp reference stay in LOCKSTEP on the ragged contract
+        # (q_lens) so tier-1's interpret/jnp runs exercise the same
+        # semantics TPUs serve.
         qg = q.reshape(B, S, cfg.n_kv_heads, cfg.q_per_kv, cfg.head_dim)
         if use_pallas:
-            out = paged_attention_chunk(
-                qg, k_all, v_all, page_table, positions, layer, interpret=interpret
+            if q_lens is not None:
+                out = ragged_paged_attention(
+                    qg, k_all, v_all, page_table, positions, q_lens, layer,
+                    interpret=interpret,
+                )
+            else:
+                out = paged_attention_chunk(
+                    qg, k_all, v_all, page_table, positions, layer,
+                    interpret=interpret,
+                )
+        elif q_lens is not None:
+            out = ragged_paged_attention_reference(
+                qg, k_all, v_all, page_table, positions, q_lens, layer
             )
         else:
             out = paged_attention_chunk_reference(

@@ -22,14 +22,19 @@ async def evaluate_planner(
     seed: int = 1234,
     shortlist_top_k: int = 6,
     use_pallas: Optional[bool] = None,
+    interpret: Optional[bool] = None,
     constrain_names: str = "registry",
     quantize: str = "none",
 ) -> dict:
     """Serve ``checkpoint`` through the real control plane (engine +
     retrieval shortlist + grammar-constrained decode) against a synthetic
     registry and return mean plan-quality + ``llm_share``. ``use_pallas``
-    defaults to whether a non-CPU backend is live (a pinned 2b on a CPU
-    host must not lower Mosaic TPU kernels). ``constrain_names`` picks the
+    defaults to whether a non-CPU backend is live; ``interpret`` defaults
+    to use_pallas-on-a-CPU-backend — the kernel then runs through the
+    Pallas interpreter instead of attempting Mosaic lowering off-TPU (a
+    pinned 2b on a CPU host would otherwise crash, and a non-aligned
+    model would silently serve jnp while the caller reports
+    ``pallas=true``). ``constrain_names`` picks the
     serving grammar tier: "registry" (default — one trie over all names,
     best batching) or "shortlist" (trie over only the prompt's shortlist —
     the tightest constraint; a tiny model that drifts to on-topic but
@@ -45,6 +50,8 @@ async def evaluate_planner(
 
     if use_pallas is None:
         use_pallas = jax.default_backend() not in ("cpu",)
+    if interpret is None:
+        interpret = bool(use_pallas) and jax.default_backend() in ("cpu",)
     cfg = MCPXConfig.from_dict(
         {
             "model": {
@@ -71,6 +78,7 @@ async def evaluate_planner(
                 "max_pages_per_seq": 4,
                 "temperature": 0.0,
                 "use_pallas": use_pallas,
+                "interpret": interpret,
                 "warmup_compile": False,
             },
             "planner": {

@@ -508,6 +508,9 @@ def build_app(cp: ControlPlane) -> web.Application:
                 {
                     "engine": engine.costs.snapshot(materialize=False),
                     "engine_state": engine.state,
+                    # Per-path ragged-kernel engagement (route resolved at
+                    # engine construction, so even a warming engine answers).
+                    "pallas": engine.pallas_paths(),
                     "device": None,
                     "reason": "engine not ready; device stats deferred",
                 }
@@ -526,6 +529,11 @@ def build_app(cp: ControlPlane) -> web.Application:
             {
                 "engine": snap,
                 "engine_state": engine.state,
+                # Per-path ragged-kernel engagement + dispatch counts
+                # (decode / suffix-prefill / spec-verify) with the blocking
+                # reason when a path is not kernel-routed — the /costs
+                # twin of the bench's per-path pallas block.
+                "pallas": engine.pallas_paths(),
                 "device": {"peaks": peaks, "hbm": hbm},
             }
         )
@@ -602,9 +610,16 @@ def build_app(cp: ControlPlane) -> web.Application:
             # grammar count — a remote operator's one-call view of whether
             # the slab is starving a traffic class, without Prometheus.
             # float()/int() also strip numpy scalar types (service_ewma_s is
-            # an np.float64), which json.dumps would reject.
+            # an np.float64), which json.dumps would reject. Nested blocks
+            # (the per-path "pallas" report, worker_profile while a
+            # profiler is attached) are plain JSON-native dicts already —
+            # pass them through untouched.
             body["engine_queue"] = {
-                k: (round(float(v), 3) if isinstance(v, float) else int(v))
+                k: (
+                    v
+                    if isinstance(v, dict)
+                    else round(float(v), 3) if isinstance(v, float) else int(v)
+                )
                 for k, v in engine.queue_stats().items()
             }
         # Surface the startup failure cause: a remote operator (or the bench

@@ -83,8 +83,16 @@ PROFILE_PHASES = (
     "admit",             # cohort assembly, geometry, page alloc, prefill dispatch
     "locality_sort",     # prefix-locality reorder of the pending line
     "prefix_match",      # radix-tree probes/fix-point during admission
-    "dispatch",          # decode-segment dispatch (async XLA enqueue)
-    "harvest",           # lagged flag/out_buf fetch + retirement
+    # The old single "dispatch" phase split (ISSUE 15): the fused-dispatch
+    # win must be ATTRIBUTABLE — submit is pure host-side XLA enqueue cost
+    # (the ~80% line the fused window amortises), sync is the blocking
+    # device wait carved out of harvest (time spent waiting on compute,
+    # not on dispatch overhead). A profile where sync grows as submit
+    # shrinks means the host stopped being the bottleneck — the intended
+    # end state.
+    "dispatch_submit",   # decode-segment dispatch (async XLA enqueue, host cost)
+    "sync",              # blocking device_get waits (carved out of harvest)
+    "harvest",           # lagged flag/out_buf fetch + retirement bookkeeping
 )
 
 # Log-ish bucket edges (seconds) for the per-phase streaming histograms:
@@ -315,6 +323,15 @@ class AnomalyDetector:
 # (MAD ~ 0) still needs a material move to alarm.
 _DETECTOR_SPECS: tuple[dict, ...] = (
     # End-to-end latency shift over the limited endpoints' histograms.
+    # Floor REVIEWED for the fused-dispatch cadence (ISSUE 15): with
+    # steps_per_dispatch=4 x decode_steps_per_tick=4, retirement is
+    # quantised to one 16-forward window (+ the pipeline's depth-1 lag),
+    # so per-request latency legitimately steps by up to ~2 windows when
+    # the knob flips — tens of ms on the CPU proxy, low single-digit ms
+    # on TPU decode. The 50 ms floor already sits above that quantum AND
+    # the detector needs `hysteresis` consecutive out-of-band windows, so
+    # fewer-but-longer dispatches cannot false-trip p99_shift; a real
+    # p99 excursion (hundreds of ms) still clears the floor easily.
     dict(name="p99_shift", signal="request_p99_ms", direction="high", floor=50.0),
     # Speculative accept-rate drop (drafter regression / grammar change).
     dict(name="accept_rate_drop", signal="spec_accept_rate", direction="low",
@@ -562,13 +579,41 @@ class FlightRecorder:
                 signals["worker_idle_share"] = round(
                     deltas.get("idle", 0.0) / attributed, 4
                 )
+                # The submit half of the old "dispatch" phase (host-side
+                # XLA enqueue — the fused-dispatch target); the legacy key
+                # keeps pre-split profiler snapshots readable.
                 signals["worker_dispatch_share"] = round(
-                    deltas.get("dispatch", 0.0) / attributed, 4
+                    (
+                        deltas.get("dispatch_submit", 0.0)
+                        + deltas.get("dispatch", 0.0)
+                    )
+                    / attributed,
+                    4,
                 )
         # Counter-derived rates.
         signals["plan_rate"] = rate("plans_total")
         signals["compile_rate"] = rate("compiles_total")
         signals["decode_tok_rate"] = rate("decode_tokens_total")
+        # Fused-dispatch cadence over THIS window (ISSUE 15): jitted
+        # decode dispatches per emitted token. Per-step dispatch sits near
+        # 1/tokens-per-tick; the fused window divides it by
+        # steps_per_dispatch — a sustained climb back up means the fused
+        # path stopped engaging (config rollback, spec-latch drain, a
+        # regression). Informational ring signal, no default detector:
+        # the cadence is config-stepped by design, and a config flip
+        # tripping an anomaly detector would train operators to ignore it.
+        if prev is not None:
+            d_seg = raw.get("segments_total", 0.0) - prev.get(
+                "segments_total", 0.0
+            )
+            d_tok = raw.get("decode_tokens_total", 0.0) - prev.get(
+                "decode_tokens_total", 0.0
+            )
+            signals["decode_dispatches_per_token"] = (
+                round(d_seg / d_tok, 4) if d_tok > 0 else None
+            )
+        else:
+            signals["decode_dispatches_per_token"] = None
         spill_rate = rate("spill_events_total")
         signals["spill_thrash_rate"] = spill_rate
         # Shed rate: share of scheduler decisions this window that shed.
@@ -718,7 +763,7 @@ def _scrape_metrics(metrics: Any) -> dict:
     public ``registry.collect()`` API (one pass, ~60 series at 1 Hz)."""
     out: dict[str, Any] = {}
     plans = compiles = decode = spill = sched_all = sched_shed = 0.0
-    matched = prefilled = drafted = accepted = 0.0
+    matched = prefilled = drafted = accepted = segments = 0.0
     buckets: dict[float, float] = {}
     limited = LIMITED_ENDPOINTS
     for family in metrics.registry.collect():
@@ -730,6 +775,8 @@ def _scrape_metrics(metrics: Any) -> dict:
                 compiles += s.value
             elif s.name == "mcpx_engine_decode_tokens_total":
                 decode += s.value
+            elif s.name == "mcpx_engine_segments_total":
+                segments += s.value
             elif s.name == "mcpx_kv_prefix_matched_tokens_total":
                 matched += s.value
             elif s.name == "mcpx_engine_prefill_tokens_total":
@@ -756,6 +803,8 @@ def _scrape_metrics(metrics: Any) -> dict:
     out["plans_total"] = plans
     out["compiles_total"] = compiles
     out["decode_tokens_total"] = decode
+    # Dispatch-cadence numerator (decode_dispatches_per_token signal).
+    out["segments_total"] = segments
     out["spill_events_total"] = spill
     out["sched_decisions_total"] = sched_all
     out["sched_shed_total"] = sched_shed

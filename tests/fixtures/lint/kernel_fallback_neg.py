@@ -1,0 +1,36 @@
+"""Negative fixtures: kernel-route literals that are CONFIGURATION, not an
+override of a resolved flag — reference harnesses, defaults in signatures,
+classes without a resolved route — plus call sites that pass the resolved
+flag through."""
+
+
+def attend(q, *, use_pallas=True, interpret=False):  # defaults: not a call site
+    return q
+
+
+class Engine:
+    def __init__(self, cfg, head_dim):
+        self._use_pallas = cfg.use_pallas and head_dim % 128 == 0
+        self._interpret = cfg.interpret
+
+    def decode_segment(self, q):
+        return attend(q, use_pallas=self._use_pallas, interpret=self._interpret)
+
+    def suffix_prefill(self, q, route):
+        return attend(q, use_pallas=route)  # resolved value as a name
+
+
+class ReferenceHarness:
+    # No resolved flag anywhere in this class: its literals ARE the
+    # configuration (a jnp-only correctness reference), not a fork.
+    def reference(self, q):
+        return attend(q, use_pallas=False)
+
+
+def forward(q, use_pallas):
+    return attend(q, use_pallas=use_pallas)  # passed through
+
+
+def standalone(q):
+    # No resolved flag in scope at all.
+    return attend(q, use_pallas=False, interpret=True)

@@ -1,0 +1,28 @@
+"""Positive fixtures: kernel-route literals at call sites that have an
+engine-resolved flag in scope — the suffix-prefill bug class (a class that
+resolves self._use_pallas, then pins one dispatch to the jnp fork), plus a
+helper that receives the resolved flag as a parameter and drops it."""
+
+
+def attend(q, *, use_pallas=True, interpret=False):
+    return q
+
+
+class Engine:
+    def __init__(self, cfg, head_dim):
+        self._use_pallas = cfg.use_pallas and head_dim % 128 == 0
+
+    def decode_segment(self, q):
+        # Honors the resolved route: not flagged.
+        return attend(q, use_pallas=self._use_pallas)
+
+    def suffix_prefill(self, q):
+        return attend(q, use_pallas=False)  # pinned off the resolved route
+
+    def verify_window(self, q):
+        return attend(q, interpret=True)  # hardcodes the lowering choice
+
+
+def forward(q, use_pallas):
+    # Receives the resolved flag, then overrides it with a literal.
+    return attend(q, use_pallas=False)

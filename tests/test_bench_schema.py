@@ -80,6 +80,21 @@ def _stats(**overrides):
         "tier": None,
         "flight": None,
         "ledger": None,
+        "kernel": None,
+        "pallas_paths": {
+            "enabled": True,
+            "interpret": True,
+            "reason": None,
+            "paths": {
+                "decode": {"engaged": True, "dispatches": 40, "reason": None},
+                "prefill": {"engaged": True, "dispatches": 12, "reason": None},
+                "spec_verify": {
+                    "engaged": True,
+                    "dispatches": 0,
+                    "reason": "idle: speculative decoding off",
+                },
+            },
+        },
         "latency_attribution": None,
         "chaos": None,
         "grammar_fallback": {"shape_only": 0, "keys_free": 0, "typed_off": 0},
@@ -110,6 +125,11 @@ def test_output_schema_carries_roofline_pallas_reason_and_verdict():
         # ISSUE 14: the cost-ledger phase block, its promoted overhead
         # key, and the per-tenant usage-attribution block.
         "ledger", "ledger_overhead_frac", "attribution",
+        # ISSUE 15: the ragged-kernel/fused-dispatch phase block, its
+        # promoted cadence/speedup keys, and the per-path pallas block.
+        "kernel", "decode_dispatches_per_token",
+        "decode_dispatches_per_token_per_step", "fused_decode_speedup",
+        "pallas_paths",
     ):
         assert key in out, key
     # ISSUE 7 fields: the roofline block…
@@ -192,6 +212,38 @@ def test_output_promotes_flight_phase_acceptance_keys():
     assert out["replan_warm_sat_p50_ms"] is None
 
 
+def test_output_promotes_kernel_phase_acceptance_keys():
+    """ISSUE 15: when the ragged-kernel/fused-dispatch phase ran, the
+    dispatch cadence (fused + per-step arms) and the wall-clock guard are
+    promoted to the top level for TRACKED_METRICS regression tracking,
+    and the per-path pallas block rides the headline."""
+    kernel = {
+        "requests": 48,
+        "rounds": 3,
+        "steps_per_dispatch": 4,
+        "per_step": {"decode_tok_s": 100.0, "dispatches_per_token": 0.26},
+        "fused": {"decode_tok_s": 120.0, "dispatches_per_token": 0.06},
+        "decode_dispatches_per_token": 0.06,
+        "decode_dispatches_per_token_per_step": 0.26,
+        "dispatch_reduction": 4.33,
+        "fused_decode_speedup": 1.2,
+        "interpret_parity": True,
+        "cadence_parity": True,
+        "pallas_paths": {"enabled": True},
+    }
+    out = bench._output_json(_stats(kernel=kernel), None, "test")
+    assert out["kernel"]["steps_per_dispatch"] == 4
+    assert out["decode_dispatches_per_token"] == 0.06
+    assert out["decode_dispatches_per_token_per_step"] == 0.26
+    assert out["fused_decode_speedup"] == 1.2
+    assert out["pallas_paths"]["paths"]["prefill"]["engaged"] is True
+    # Skipped phase: block and promoted keys null, never absent.
+    out = bench._output_json(_stats(), None, "test")
+    assert out["kernel"] is None
+    assert out["decode_dispatches_per_token"] is None
+    assert out["fused_decode_speedup"] is None
+
+
 def test_output_promotes_ledger_phase_acceptance_keys():
     """ISSUE 14: when the cost-ledger phase ran, the overhead fraction
     and the attribution block are promoted to the top level (regression
@@ -272,9 +324,17 @@ def test_roofline_block_from_cost_snapshots():
 
 
 def test_pallas_reason_covers_the_off_paths(monkeypatch):
-    # CPU backend (the tier-1 platform).
+    # CPU backend (the tier-1 platform): since ISSUE 15 the kernel serves
+    # through the Pallas interpreter by default — the reason says so —
+    # and MCPX_BENCH_PALLAS=0 restores the jnp proxy, reasoned.
     monkeypatch.setattr(bench, "_on_tpu", lambda: False)
-    assert "cpu backend" in bench._pallas_reason()
+    monkeypatch.delenv("MCPX_BENCH_PALLAS", raising=False)
+    assert "interpret" in bench._pallas_reason()
+    assert bench._pallas_on() is True
+    monkeypatch.setenv("MCPX_BENCH_PALLAS", "0")
+    assert "MCPX_BENCH_PALLAS=0" in bench._pallas_reason()
+    assert bench._pallas_on() is False
+    monkeypatch.delenv("MCPX_BENCH_PALLAS")
     # Operator override on TPU.
     monkeypatch.setattr(bench, "_on_tpu", lambda: True)
     monkeypatch.setenv("MCPX_BENCH_PALLAS", "0")

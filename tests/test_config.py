@@ -40,6 +40,20 @@ def test_invalid_planner_kind_rejected():
         MCPXConfig.from_dict({"planner": {"kind": "oracle"}})
 
 
+def test_steps_per_dispatch_roundtrip_and_bounds():
+    """Fused multi-step dispatch knob (ISSUE 15): round-trips like every
+    engine field, 1 = legacy per-tick cadence is legal, and out-of-range
+    windows are rejected (not clamped silently)."""
+    cfg = MCPXConfig.from_dict({"engine": {"steps_per_dispatch": 8}})
+    assert cfg.engine.steps_per_dispatch == 8
+    assert cfg.to_dict()["engine"]["steps_per_dispatch"] == 8
+    MCPXConfig.from_dict({"engine": {"steps_per_dispatch": 1}}).validate()
+    with pytest.raises(ConfigError, match="steps_per_dispatch"):
+        MCPXConfig.from_dict({"engine": {"steps_per_dispatch": 0}})
+    with pytest.raises(ConfigError, match="steps_per_dispatch"):
+        MCPXConfig.from_dict({"engine": {"steps_per_dispatch": 65}})
+
+
 def test_nested_speculative_from_dict_roundtrip():
     """engine.speculative is a NESTED dataclass: dict loading reaches one
     level deeper with the same key checking and string coercion, and

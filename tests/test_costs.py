@@ -305,6 +305,14 @@ def test_costs_endpoint_with_engine_serves_snapshot():
             assert body["engine_state"] == "ready"
             assert body["engine"]["executables"]["prefill"]["compiles"] >= 1
             assert body["engine"]["totals"]["flops_executed"] > 0
+            # Per-path kernel engagement (ISSUE 15): this engine forces
+            # use_pallas=False, so every path reports the jnp route WITH
+            # its blocking reason, and the decode path counted dispatches.
+            pal = body["pallas"]
+            assert set(pal["paths"]) == {"decode", "prefill", "spec_verify"}
+            assert pal["enabled"] is False
+            assert "use_pallas=false" in pal["reason"]
+            assert pal["paths"]["decode"]["dispatches"] >= 1
             peaks = body["device"]["peaks"]
             assert "device_kind" in peaks and "n_devices" in peaks
             assert isinstance(body["device"]["hbm"], list)

@@ -28,6 +28,7 @@ RULE_IDS = {
     "traced-control-flow",
     "jit-static-branch",
     "per-token-host-loop",
+    "hardcoded-kernel-fallback",
     "broad-except",
     "blank-lines",
     "unbounded-retry-loop",
@@ -122,6 +123,24 @@ def test_per_token_host_loop_negative():
     # (jit-host-sync's business) and feedback through plain-Python helpers
     # stay silent.
     assert hits("per_token_host_loop_neg.py", "per-token-host-loop") == []
+
+
+def test_hardcoded_kernel_fallback_positive():
+    # A class that resolves self._use_pallas pinning one call site to
+    # use_pallas=False, another to a literal interpret=, and a function
+    # that receives the resolved flag but overrides it with a literal —
+    # the suffix-prefill bug class (ISSUE 15).
+    assert hits("kernel_fallback_pos.py", "hardcoded-kernel-fallback") == [
+        20, 23, 28,
+    ]
+
+
+def test_hardcoded_kernel_fallback_negative():
+    # Resolved flags passed through, literals in classes WITHOUT a
+    # resolved route (reference harnesses), signature defaults, and
+    # standalone functions stay silent — those literals are the
+    # configuration, not an override.
+    assert hits("kernel_fallback_neg.py", "hardcoded-kernel-fallback") == []
 
 
 def test_metric_label_churn_positive():

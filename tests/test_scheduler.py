@@ -337,6 +337,51 @@ def test_engine_queue_stats_surface():
     eng = InferenceEngine(cfg)
     st = eng.queue_stats()
     assert st == {
+        # Per-path ragged-kernel engagement (ISSUE 15): resolved at
+        # construction (config + head-dim probe) so a COLD engine already
+        # answers; the "test" model's head_dim aligns off-TPU only via
+        # interpret, which this config leaves off -> jnp route, reasoned.
+        "pallas": {
+            "enabled": False,
+            "interpret": False,
+            "reason": (
+                "head_dim 32 % 128 != 0: Mosaic lane tiling rejects the "
+                "kernel on hardware (engine.interpret=true lifts the "
+                "constraint off-TPU)"
+            ),
+            "paths": {
+                "decode": {
+                    "engaged": False,
+                    "dispatches": 0,
+                    "reason": (
+                        "head_dim 32 % 128 != 0: Mosaic lane tiling "
+                        "rejects the kernel on hardware "
+                        "(engine.interpret=true lifts the constraint "
+                        "off-TPU)"
+                    ),
+                },
+                "prefill": {
+                    "engaged": False,
+                    "dispatches": 0,
+                    "reason": (
+                        "head_dim 32 % 128 != 0: Mosaic lane tiling "
+                        "rejects the kernel on hardware "
+                        "(engine.interpret=true lifts the constraint "
+                        "off-TPU)"
+                    ),
+                },
+                "spec_verify": {
+                    "engaged": False,
+                    "dispatches": 0,
+                    "reason": (
+                        "head_dim 32 % 128 != 0: Mosaic lane tiling "
+                        "rejects the kernel on hardware "
+                        "(engine.interpret=true lifts the constraint "
+                        "off-TPU)"
+                    ),
+                },
+            },
+        },
         # Radix prefix-cache scoreboard (prefix-locality admission): empty
         # tree, no lookups yet.
         "prefix_nodes": 0,
