@@ -2543,6 +2543,450 @@ async def _kernel_phase(cp) -> "dict | None":
     }
 
 
+class _SimReplicaEngine:
+    """Deterministic engine stand-in for the ROUTER-LEVEL cluster arms.
+
+    On the CPU proxy every real engine replica shares the same host cores,
+    so compute-bound plans/s cannot scale with replica count no matter what
+    the router does — the scaling/failover/affinity arms would measure host
+    contention, not routing. This stand-in gives each replica its own
+    bounded service capacity (``slots`` concurrent requests, a fixed
+    ``service_s`` wall per request via asyncio.sleep — wall time the event
+    loop concurrency genuinely overlaps) and a radix-style prefix cache at
+    FAMILY granularity (LRU over page-aligned prompt heads, capacity
+    ``cache_families``), so plans/s, p99-under-kill and routed-vs-RR token
+    hit rate are measured through the REAL EnginePool/RoutingPipeline with
+    replica economics a single host can honestly host. The phase labels
+    these numbers basis="router-sim"; the warm-rejoin arm uses real engines
+    and inherits the run's measurement basis.
+    """
+
+    def __init__(
+        self, *, slots: int, service_s: float, prefix_tokens: int,
+        cache_families: int,
+    ) -> None:
+        from collections import OrderedDict
+
+        self.state = "cold"
+        self.metrics = None
+        self.costs = None
+        self.tokenizer = None
+        self._slots = slots
+        self._service_s = service_s
+        self._sem = asyncio.Semaphore(slots)
+        self._prefix_tokens = prefix_tokens
+        self._cache: "OrderedDict[tuple, None]" = OrderedDict()
+        self._cache_cap = cache_families
+        self.hit_tokens = 0
+        self.miss_tokens = 0
+        self._depth = 0
+        self._active = 0
+
+    async def start(self) -> None:
+        self.state = "ready"
+
+    async def aclose(self) -> None:
+        self.state = "closed"
+
+    async def generate(self, prompt_ids, **kw):
+        from mcpx.core.errors import EngineError
+
+        self._depth += 1
+        async with self._sem:
+            self._depth -= 1
+            self._active += 1
+            try:
+                await asyncio.sleep(self._service_s)
+            finally:
+                self._active -= 1
+        if self.state != "ready":
+            # Killed mid-request: the pool re-steers this request to a
+            # survivor (where it re-prefills — counted as that replica's
+            # miss, exactly like a real cold re-prefill).
+            raise EngineError("replica closed mid-request")
+        head = tuple(prompt_ids[: self._prefix_tokens])
+        if head in self._cache:
+            self._cache.move_to_end(head)
+            self.hit_tokens += len(head)
+        else:
+            self.miss_tokens += len(head)
+            self._cache[head] = None
+            while len(self._cache) > self._cache_cap:
+                self._cache.popitem(last=False)
+        return None
+
+    def queue_stats(self) -> dict:
+        seen = self.hit_tokens + self.miss_tokens
+        return {
+            "pallas": False,
+            "depth": self._depth,
+            "active": self._active,
+            "service_ewma_s": self._service_s,
+            "eta_s": self._service_s * (self._depth + self._active) / self._slots,
+            "depth_constrained": 0,
+            "depth_free": self._depth,
+            "hol_wait_ms": 0.0,
+            "resident_grammars": 0,
+            "prefix_nodes": len(self._cache),
+            "prefix_resident_pages": len(self._cache),
+            "prefix_hit_rate": self.hit_tokens / max(1, seen),
+            "prefix_token_hit_rate": self.hit_tokens / max(1, seen),
+            "prefix_host_pages": 0,
+            "prefix_spills": 0,
+            "prefix_readmits": 0,
+            "prefix_destructive_evictions": 0,
+            "spec_accept_rate": 0.0,
+            "spec_accept_rate_constrained": 0.0,
+            "spec_accept_rate_free": 0.0,
+        }
+
+
+async def _cluster_phase(cp) -> "dict | None":
+    """Cluster scale-out scenario (ISSUE 16 acceptance), four arms:
+
+      1. **scaling** — closed-loop plans/s through the real EnginePool at
+         1/2/4 replicas of fixed per-replica capacity (router-sim basis,
+         see _SimReplicaEngine) — near-linear is the acceptance.
+      2. **one-down** — open-loop at ~45% of 4-replica capacity; one
+         replica is KILLED mid-phase. In-flight requests on the dead
+         replica re-steer to survivors (one retry, re-prefill there), so
+         client-visible failures must be ZERO and p99 must stay flat-ish
+         (3 replicas still clear the offered load). The dead slot then
+         rejoins with a bumped generation.
+      3. **affinity A/B** — the SAME shuffled repeat-heavy stream (more
+         prefix families than one replica's cache holds, fewer than the
+         pool holds when split by rendezvous hash) routed by the default
+         affinity pipeline vs RoundRobinPolicy; routed token hit rate
+         must beat round-robin by a real margin (gated).
+      4. **warm rejoin** — REAL engines (2-replica pool, tiny geometry,
+         kv_tier + cluster.warm_snapshot_dir): serve a prompt on its
+         affinity replica, kill it (the close writes the PR 11 KV
+         snapshot), rejoin (the fresh engine restores it in start()),
+         and assert the rejoined replica's first plan prefills strictly
+         fewer tokens than cold — greedy output byte-identical.
+
+    Dedicated pools only — the serving engine sits idle. Skip with
+    MCPX_BENCH_CLUSTER=0."""
+    if os.environ.get("MCPX_BENCH_CLUSTER", "1") == "0":
+        return None
+    serving = getattr(cp.planner, "engine", None)
+    if serving is None or serving.state != "ready":
+        return None
+    import contextlib
+    import random
+    import shutil
+    import tempfile
+
+    from mcpx.cluster import EnginePool, RoundRobinPolicy, RoutingPipeline
+    from mcpx.core.config import MCPXConfig
+
+    SLOTS = 4
+    SERVICE_S = 0.02
+    PREFIX_TOKENS = 64
+    FAMILIES = 33  # coprime with every replica count used below
+    CACHE_CAP = 12  # < FAMILIES (RR thrashes), > FAMILIES/4 (affinity fits)
+    ARMS = (1, 2, 4)
+
+    def sim_pool(n: int, *, pipeline=None) -> EnginePool:
+        cfg = MCPXConfig.from_dict(
+            {
+                "planner": {"kind": "llm"},
+                "engine": {"kv_page_size": 16},
+                "cluster": {
+                    "enabled": True,
+                    "replicas": n,
+                    "affinity": True,
+                    "affinity_prefix_tokens": PREFIX_TOKENS,
+                    # Refresh faster than a service interval: the queue
+                    # baseline routes off the scoreboard snapshot, and a
+                    # snapshot stale by several completions re-piles onto
+                    # the same replica between refreshes.
+                    "scoreboard_interval_s": 0.01,
+                },
+            }
+        )
+        return EnginePool(
+            cfg,
+            engine_factory=lambda i, c: _SimReplicaEngine(
+                slots=SLOTS,
+                service_s=SERVICE_S,
+                prefix_tokens=PREFIX_TOKENS,
+                cache_families=CACHE_CAP,
+            ),
+            pipeline=pipeline,
+        )
+
+    def family_stream(n_requests: int, seed: int) -> list:
+        """Repeat-heavy prompts: a per-family 64-token head (the affinity
+        key) + a unique tail; shuffled so round-robin sprays families."""
+        rng = random.Random(seed)
+        prompts = [
+            [1000 + (i % FAMILIES) * 131 + t for t in range(PREFIX_TOKENS)]
+            + [rng.randrange(20000, 90000) for _ in range(8)]
+            for i in range(n_requests)
+        ]
+        rng.shuffle(prompts)
+        return prompts
+
+    async def with_pool(pool, body):
+        await pool.start()
+        sb = asyncio.create_task(pool.run_scoreboard())
+        try:
+            return await body(pool)
+        finally:
+            sb.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await sb
+            await pool.aclose()
+
+    # ---- arm 1: closed-loop plans/s at 1/2/4 replicas.
+    pps: dict[str, float] = {}
+    for n in ARMS:
+        n_req = 80 * n
+        prompts = family_stream(n_req, seed=3)
+
+        async def closed(pool, n_req=n_req, prompts=prompts, n=n):
+            semc = asyncio.Semaphore(2 * SLOTS * n)
+
+            async def one(p):
+                async with semc:
+                    await pool.generate(p, max_new_tokens=2)
+
+            t0 = time.monotonic()
+            await asyncio.gather(*(one(p) for p in prompts))
+            return n_req / (time.monotonic() - t0)
+
+        pps[str(n)] = round(await with_pool(sim_pool(n), closed), 1)
+    linearity = round(pps[str(ARMS[-1])] / (ARMS[-1] * pps["1"]), 3)
+    if linearity < 0.7:
+        raise BenchGateError(
+            f"cluster plans/s scaling_linearity={linearity} < 0.7 at "
+            f"{ARMS[-1]} replicas — routing serializes what the replicas "
+            "could overlap"
+        )
+
+    # ---- arm 2: open-loop p99 with one replica killed mid-phase.
+    rate = 0.45 * 4 * SLOTS / SERVICE_S
+    n_open = int(rate * 1.6)
+    kill_at_s = 0.6
+
+    async def open_arm(pool, *, kill: bool):
+        prompts = family_stream(n_open, seed=5)
+        lat: list[float] = []
+        failures = 0
+
+        async def one(i: int) -> None:
+            nonlocal failures
+            await asyncio.sleep(i / rate)
+            t0 = time.monotonic()
+            try:
+                await pool.generate(prompts[i], max_new_tokens=2)
+            except Exception:  # noqa: BLE001 - counted, gated below
+                failures += 1
+                return
+            lat.append((time.monotonic() - t0) * 1e3)
+
+        killer = None
+        if kill:
+            async def do_kill():
+                await asyncio.sleep(kill_at_s)
+                await pool.kill(1)
+
+            killer = asyncio.create_task(do_kill())
+        await asyncio.gather(*(one(i) for i in range(n_open)))
+        if killer is not None:
+            await killer
+        rejoin_gen = None
+        if kill:
+            await pool.rejoin(1)
+            rejoin_gen = pool.replicas[1].generation
+        lat.sort()
+        return {
+            "p99_ms": round(lat[int(0.99 * (len(lat) - 1))], 1),
+            "served": len(lat),
+            "failures": failures,
+            "resteered": pool.resteers,
+            "rejoin_generation": rejoin_gen,
+        }
+
+    base_arm = await with_pool(
+        sim_pool(4), lambda pool: open_arm(pool, kill=False)
+    )
+    down_arm = await with_pool(
+        sim_pool(4), lambda pool: open_arm(pool, kill=True)
+    )
+    if down_arm["failures"] > 0:
+        raise BenchGateError(
+            f"replica kill leaked {down_arm['failures']} client-visible "
+            "failures — the router must re-steer everything beyond the "
+            "dead replica's resident rows"
+        )
+    p99_ratio = round(down_arm["p99_ms"] / max(1e-9, base_arm["p99_ms"]), 2)
+    if p99_ratio > 3.0:
+        raise BenchGateError(
+            f"p99 with one replica down is {p99_ratio}x the all-up "
+            "baseline — failover is not absorbing the lost capacity"
+        )
+
+    # ---- arm 3: routed (affinity) vs round-robin prefix token hit rate.
+    async def hit_arm(pool) -> dict:
+        prompts = family_stream(FAMILIES * 6, seed=7)
+        semc = asyncio.Semaphore(12)
+
+        async def one(p):
+            async with semc:
+                await pool.generate(p, max_new_tokens=2)
+
+        await asyncio.gather(*(one(p) for p in prompts))
+        hit = sum(r.engine.hit_tokens for r in pool.replicas)
+        miss = sum(r.engine.miss_tokens for r in pool.replicas)
+        return {
+            "token_hit_rate": round(hit / max(1, hit + miss), 4),
+            "requests": len(prompts),
+            "affinity_hits": sum(r.affinity_hits for r in pool.replicas),
+            "scoreboard": pool.scoreboard_snapshot(),
+        }
+
+    routed = await with_pool(sim_pool(4), hit_arm)
+    rr = await with_pool(
+        sim_pool(4, pipeline=RoutingPipeline([RoundRobinPolicy()])), hit_arm
+    )
+    margin = round(routed["token_hit_rate"] - rr["token_hit_rate"], 4)
+    if margin <= 0.1:
+        raise BenchGateError(
+            f"routed token_hit_rate={routed['token_hit_rate']} vs "
+            f"round_robin={rr['token_hit_rate']} (margin {margin} <= 0.1) "
+            "— prefix affinity is not preserving KV locality"
+        )
+
+    # ---- arm 4: warm rejoin through REAL engines (PR 11 KV snapshot as
+    # the replica warm-up path).
+    snap_dir = tempfile.mkdtemp(prefix="mcpx-cluster-")
+    d = serving.config.to_dict()
+    d["engine"].update(
+        {
+            "data_axis": 1,
+            "model_axis": 1,
+            "warmup_compile": False,
+            "hetero_batch": False,
+            "max_batch_size": 4,
+            "max_pages_per_seq": 16,
+            "kv_page_size": 16,
+            "max_decode_len": 8,
+            "prefix_cache": True,
+            "prefix_cache_entries": 4096,
+        }
+    )
+    d["engine"]["speculative"] = {"enabled": False}
+    d["engine"]["kv_tier"] = {"enabled": True, "host_mb": 64.0,
+                              "copy_tokens_per_cycle": 4096}
+    d["planner"]["kind"] = "llm"
+    d["cluster"] = {
+        "enabled": True,
+        "replicas": 2,
+        "affinity": True,
+        "affinity_prefix_tokens": PREFIX_TOKENS,
+        "warm_snapshot_dir": snap_dir,
+    }
+    prompt = serving.tokenizer.encode(
+        "cluster warm rejoin probe: " + "compose rank fetch join " * 12
+    )[:128]
+    cold_aligned = float((len(prompt) // 16) * 16)
+
+    def prom() -> dict:
+        return _parse_prom(cp.metrics.render().decode())
+
+    async def pool_idle(pool) -> None:
+        for r in pool.replicas:
+            eng = r.engine
+            if getattr(eng, "state", "") != "ready":
+                continue
+            while eng._slab.n_active or eng._queue.qsize():
+                await asyncio.sleep(0.02)
+        await asyncio.sleep(0.05)
+
+    rpool = EnginePool(MCPXConfig.from_dict(d), metrics=cp.metrics)
+    try:
+        await rpool.start()
+        target = rpool._affinity_replica(prompt).index
+        pf0 = prom().get("mcpx_engine_prefill_tokens_total", 0.0)
+        r_cold = await rpool.generate(
+            prompt, max_new_tokens=2, constrained=False, temperature=0.0
+        )
+        await pool_idle(rpool)
+        cold_first = prom().get("mcpx_engine_prefill_tokens_total", 0.0) - pf0
+        await rpool.kill(target)  # clean close writes the KV snapshot
+        await rpool.rejoin(target)  # fresh engine restores it in start()
+        pf1 = prom().get("mcpx_engine_prefill_tokens_total", 0.0)
+        r_warm = await rpool.generate(
+            prompt, max_new_tokens=2, constrained=False, temperature=0.0
+        )
+        await pool_idle(rpool)
+        warm_first = prom().get("mcpx_engine_prefill_tokens_total", 0.0) - pf1
+        rejoin_landed = rpool.replicas[target].routed >= 2
+        if r_warm.token_ids != r_cold.token_ids:
+            raise BenchGateError(
+                "rejoined replica's greedy output diverged from cold — "
+                "restored KV must attend byte-identically"
+            )
+        if not warm_first < cold_first:
+            raise BenchGateError(
+                f"rejoined replica prefilled {warm_first} tokens vs "
+                f"{cold_first} cold — the warm-restart snapshot did not "
+                "warm the replica"
+            )
+    finally:
+        with contextlib.suppress(Exception):
+            await rpool.aclose()
+        shutil.rmtree(snap_dir, ignore_errors=True)
+
+    warm_ratio = round(cold_first / warm_first, 2) if warm_first > 0 else None
+    return {
+        # Basis labels (ROADMAP item 4): arms 1-3 measure the real router
+        # over simulated per-replica capacity; arm 4 is real engines on
+        # the run's platform basis.
+        "basis": {"scaling": "router-sim", "warm_rejoin": _measurement_basis()},
+        "sim": {
+            "slots": SLOTS,
+            "service_s": SERVICE_S,
+            "families": FAMILIES,
+            "cache_families": CACHE_CAP,
+        },
+        "plans_per_sec": pps,
+        "cluster_scaling_linearity": linearity,
+        "one_down": {
+            "rate_per_s": round(rate, 1),
+            "requests": n_open,
+            "kill_at_s": kill_at_s,
+            "p99_ms_baseline": base_arm["p99_ms"],
+            "p99_ms_one_down": down_arm["p99_ms"],
+            "resteered": down_arm["resteered"],
+            "failures": down_arm["failures"],
+            "rejoin_generation": down_arm["rejoin_generation"],
+        },
+        "cluster_p99_one_down_ratio": p99_ratio,
+        "affinity": {
+            "requests": routed["requests"],
+            "routed": {k: routed[k] for k in ("token_hit_rate", "affinity_hits")},
+            "round_robin": {"token_hit_rate": rr["token_hit_rate"]},
+        },
+        "cluster_routed_token_hit_rate": routed["token_hit_rate"],
+        "cluster_rr_token_hit_rate": rr["token_hit_rate"],
+        "cluster_affinity_hit_margin": margin,
+        "warm_rejoin": {
+            "replica": target,
+            "cold_first_plan_prefill_tokens": cold_first,
+            "cold_first_plan_prefill_aligned": cold_aligned,
+            "rejoin_first_plan_prefill_tokens": warm_first,
+            "prefill_ratio": warm_ratio,
+            "landed_on_rejoined": rejoin_landed,
+            "parity_ok": True,
+        },
+        "cluster_warm_rejoin_prefill_ratio": warm_ratio,
+        "scoreboard": routed["scoreboard"],
+    }
+
+
 async def _run(model_size: str, n_requests: int, concurrency: int, n_services: int) -> dict:
     from aiohttp import ClientSession, TCPConnector
     from aiohttp.test_utils import TestServer
@@ -2789,6 +3233,12 @@ async def _run(model_size: str, n_requests: int, concurrency: int, n_services: i
         # engines' alone.
         kernel = await _kernel_phase(cp)
 
+        # ---- Phase 13: cluster scale-out (ISSUE 16) — dedicated pools
+        # (router-sim replicas for scaling/failover/affinity, real small
+        # engines for the warm-rejoin snapshot arm); the serving engine
+        # sits idle throughout.
+        cluster = await _cluster_phase(cp)
+
         # ---- Phase 5: latency attribution (ISSUE 4) — a traced open-loop
         # sample at the phase-2 rate; runs after every headline scrape
         # because attaching the tracer is the one thing this phase does
@@ -2956,6 +3406,11 @@ async def _run(model_size: str, n_requests: int, concurrency: int, n_services: i
         # load, dispatch-per-token drop, wall-clock guard, and the
         # kernel-vs-jnp interpret-parity verdict.
         "kernel": kernel,
+        # Cluster scale-out scenario (None when skipped): plans/s at
+        # 1/2/4 replicas through the real router (router-sim basis), p99
+        # with one replica killed mid-phase, routed-vs-round-robin prefix
+        # token hit rate, and the warm-rejoin KV-snapshot prefill ratio.
+        "cluster": cluster,
         # Per-phase latency attribution from sampled request traces (None
         # when skipped): p50/p99 of scheduler-queue vs engine admit-wait vs
         # prefill vs decode vs tool fan-out, plus each phase's share of the
@@ -3144,6 +3599,19 @@ def _pallas_on() -> bool:
     if not _on_tpu():
         return True
     return bool(_smoke_artifact().get("pallas", True))
+
+
+def _measurement_basis() -> str:
+    """The run's measurement basis (ROADMAP item 4), as a first-class
+    scenario dimension: ``real-TPU`` (Mosaic kernels on hardware),
+    ``interpret-kernel`` (CPU proxy serving the same kernel body through
+    the Pallas interpreter — the r09 default), or ``jnp-proxy`` (the
+    fused-jnp reference, MCPX_BENCH_PALLAS=0 off-TPU). `mcpx bench
+    report` keys scenarios on this, so a basis change reads as a NEW
+    series, not a regression."""
+    if _on_tpu():
+        return "real-TPU"
+    return "interpret-kernel" if _pallas_on() else "jnp-proxy"
 
 
 def _pallas_reason(engine_use_pallas: "bool | None" = None) -> str:
@@ -3374,6 +3842,12 @@ def _output_json(stats: dict, quality_trained, model: str) -> dict:
                 "quantize": os.environ.get("MCPX_BENCH_QUANTIZE", "none"),
                 "registry": os.environ.get("MCPX_BENCH_REGISTRY", "synthetic"),
                 "backend": stats["backend"],
+                # Measurement basis as a first-class scenario dimension
+                # (ROADMAP item 4): jnp-proxy / interpret-kernel /
+                # real-TPU — `mcpx bench report` refuses to compare runs
+                # across a basis change (a measurement change is not a
+                # performance change).
+                "measurement_basis": _measurement_basis(),
                 "n_services": stats["n_services"],
                 "requests": stats["n_requests"],
                 "errors": stats["errors"],
@@ -3475,6 +3949,36 @@ def _output_json(stats: dict, quality_trained, model: str) -> dict:
                 "fused_decode_speedup": (
                     stats["kernel"]["fused_decode_speedup"]
                     if stats.get("kernel") else None
+                ),
+                "cluster": stats.get("cluster"),
+                # Acceptance keys promoted to the top level (ISSUE 16):
+                # plans/s linearity over replicas (router-sim basis), p99
+                # with one replica killed mid-phase over the all-up
+                # baseline, routed-vs-round-robin prefix token hit rate,
+                # and the rejoined replica's warm-restart prefill ratio.
+                "cluster_scaling_linearity": (
+                    stats["cluster"]["cluster_scaling_linearity"]
+                    if stats.get("cluster") else None
+                ),
+                "cluster_p99_one_down_ratio": (
+                    stats["cluster"]["cluster_p99_one_down_ratio"]
+                    if stats.get("cluster") else None
+                ),
+                "cluster_routed_token_hit_rate": (
+                    stats["cluster"]["cluster_routed_token_hit_rate"]
+                    if stats.get("cluster") else None
+                ),
+                "cluster_rr_token_hit_rate": (
+                    stats["cluster"]["cluster_rr_token_hit_rate"]
+                    if stats.get("cluster") else None
+                ),
+                "cluster_affinity_hit_margin": (
+                    stats["cluster"]["cluster_affinity_hit_margin"]
+                    if stats.get("cluster") else None
+                ),
+                "cluster_warm_rejoin_prefill_ratio": (
+                    stats["cluster"]["cluster_warm_rejoin_prefill_ratio"]
+                    if stats.get("cluster") else None
                 ),
                 "ledger": stats.get("ledger"),
                 # Acceptance keys promoted to the top level (ISSUE 14):

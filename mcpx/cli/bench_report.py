@@ -9,9 +9,14 @@ before it, so a regression had to be spotted by a human diffing JSON
 closes the loop:
 
   - **Scenario keying**: runs are only compared within the same scenario —
-    (model, backend, vocab, quantize, registry mode, n_services). A CPU
-    proxy run never regresses against a TPU run; mismatched runs are
-    listed as excluded, not silently mixed.
+    (model, backend, vocab, quantize, registry mode, n_services,
+    measurement basis). A CPU proxy run never regresses against a TPU
+    run; mismatched runs are listed as excluded, not silently mixed. The
+    measurement basis (jnp-proxy / interpret-kernel / real-TPU) is a
+    first-class dimension since r10 — r09's interpreter switch changed
+    what the numbers MEASURE, and such a shift must read as a new series,
+    not a regression. Artifacts predating the field get the basis derived
+    from what they recorded (backend, pallas flag, pallas_paths presence).
   - **Noise bands**: per metric, the relative spread of the PRIOR runs
     (median absolute deviation, doubled) sets the band; with fewer than
     three priors the band falls back to ``DEFAULT_BAND`` (25% — the CPU
@@ -68,6 +73,11 @@ TRACKED_METRICS: tuple[tuple[str, str, Optional[str]], ...] = (
     ("warm_restart_prefill_ratio", "higher", None),
     ("chaos_success_rate", "higher", None),
     ("deadline_overrun_share", "lower", None),
+    ("cluster_scaling_linearity", "higher", None),
+    ("cluster_p99_one_down_ratio", "lower", None),
+    ("cluster_routed_token_hit_rate", "higher", None),
+    ("cluster_affinity_hit_margin", "higher", None),
+    ("cluster_warm_rejoin_prefill_ratio", "higher", None),
     ("plan_quality_trained.score", "higher", None),
 )
 
@@ -79,17 +89,38 @@ DEFAULT_BAND = 0.25
 # flag 1% wiggles on a shared-core host.
 MIN_BAND = 0.05
 
-_SCENARIO_KEYS = ("model", "backend", "vocab", "quantize", "registry", "n_services")
+_SCENARIO_KEYS = (
+    "model", "backend", "vocab", "quantize", "registry", "n_services",
+    "measurement_basis",
+)
+
+
+def _derive_basis(run: dict) -> str:
+    """Measurement basis for artifacts that predate the explicit field:
+    the TPU backend is real hardware; on the CPU proxy, ``pallas_paths``
+    appeared in the same round (r09) the interpreter became the kernel
+    route, so pallas=true WITH the block means interpret-kernel and
+    everything earlier is the fused-jnp reference."""
+    if run.get("backend") == "tpu":
+        return "real-TPU"
+    if run.get("pallas") and run.get("pallas_paths") is not None:
+        return "interpret-kernel"
+    return "jnp-proxy"
 
 
 def _unwrap(obj: dict) -> Optional[dict]:
     """The bench payload from either a raw bench line or the driver's
-    ``{"parsed": ...}`` wrapper; None when neither shape matches."""
+    ``{"parsed": ...}`` wrapper; None when neither shape matches. Backfills
+    ``measurement_basis`` on pre-r10 artifacts so the scenario key never
+    wildcards across a basis change."""
     if not isinstance(obj, dict):
         return None
     if isinstance(obj.get("parsed"), dict):
         obj = obj["parsed"]
-    return obj if obj.get("metric") == "plans_per_sec" else None
+    if obj.get("metric") != "plans_per_sec":
+        return None
+    obj.setdefault("measurement_basis", _derive_basis(obj))
+    return obj
 
 
 def _scenario(run: dict) -> tuple:

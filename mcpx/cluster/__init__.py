@@ -1,0 +1,39 @@
+"""Cluster layer (docs/cluster.md): a multi-replica engine pool behind the
+single-engine duck surface, a scored routing pipeline (queue/ETA baseline,
+prefix-locality affinity, cost/burn-aware placement), replica lifecycle
+(spawn/warm/drain/kill/rejoin with warm-restart snapshots), and row-sharded
+registry retrieval. ``cluster.enabled=false`` (the default) builds none of
+this — the factory's single bare engine path is byte-identical.
+
+``ShardedRetrievalIndex`` is imported lazily (it pulls in JAX); everything
+else here is plain-Python and safe to import from tests and the CLI.
+"""
+
+from mcpx.cluster.pool import ClusterPin, EnginePool
+from mcpx.cluster.replica import ReplicaHandle
+from mcpx.cluster.routing import (
+    CostBurnPolicy,
+    PrefixAffinityPolicy,
+    QueueDepthPolicy,
+    RoundRobinPolicy,
+    RouteRequest,
+    RoutingPipeline,
+    affinity_key,
+    build_pipeline,
+    rendezvous_choice,
+)
+
+__all__ = [
+    "ClusterPin",
+    "CostBurnPolicy",
+    "EnginePool",
+    "PrefixAffinityPolicy",
+    "QueueDepthPolicy",
+    "ReplicaHandle",
+    "RoundRobinPolicy",
+    "RouteRequest",
+    "RoutingPipeline",
+    "affinity_key",
+    "build_pipeline",
+    "rendezvous_choice",
+]

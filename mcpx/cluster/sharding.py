@@ -1,0 +1,108 @@
+"""Registry sharding: the service-embedding table partitioned row-wise.
+
+At 100k services the [N, d] embedding table stops being a thing every
+replica should hold whole in HBM next to its model weights. The sharded
+index splits the table into contiguous row ranges — one shard per
+replica by default — runs the SAME jitted ``scores = shard @ q ->
+lax.top_k`` per shard (each shard's rows still spread over the model
+axis via the parent's partition rule when a mesh is present), and merges
+the per-shard (score, global_row) candidates HOST-side: k floats + k
+ints per shard is wire-trivial next to shipping score vectors around.
+
+The merge is exact: the global top-k is always contained in the union
+of shard-local top-ks (every global winner is a winner of its own
+shard), so sharded and unsharded shortlists agree wherever scores are
+distinct — property-tested in tests/test_cluster.py.
+
+Host-mode registries (below ``device_threshold``) run the identical
+shard/merge arithmetic over the numpy mirror, so CPU tests exercise the
+same code path TPU serving uses.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from mcpx.core.config import RetrievalConfig
+from mcpx.retrieval.index import RetrievalIndex, _topk_scores
+
+
+class ShardedRetrievalIndex(RetrievalIndex):
+    def __init__(
+        self,
+        config: Optional[RetrievalConfig] = None,
+        *,
+        n_shards: int = 2,
+        embedder=None,
+        mesh=None,
+    ) -> None:
+        super().__init__(config, embedder=embedder, mesh=mesh)
+        self.n_shards = max(1, int(n_shards))
+        self._shards: list = []  # per-shard device tables
+        self._offsets: list[int] = []  # global row of each shard's row 0
+
+    # ------------------------------------------------------------- placement
+    def _place(self, table: np.ndarray):
+        """Split into near-equal contiguous row ranges and place each with
+        the parent's sharding rule. Returns None: the full-table device
+        copy is REPLACED by the shard list (``_base_order`` dispatches on
+        it), which also keeps the parent's host-mode branch intact."""
+        self._shards, self._offsets = [], []
+        n = table.shape[0]
+        per = -(-n // self.n_shards)  # ceil
+        for s in range(self.n_shards):
+            lo, hi = s * per, min(n, (s + 1) * per)
+            if lo >= hi:
+                break
+            self._offsets.append(lo)
+            self._shards.append(super()._place(np.ascontiguousarray(table[lo:hi])))
+        return None
+
+    @property
+    def shard_sizes(self) -> list[int]:
+        if self._shards:
+            return [int(t.shape[0]) for t in self._shards]
+        if self._table_np is None:
+            return []
+        n = self._table_np.shape[0]
+        per = -(-n // self.n_shards)
+        return [min(n, (s + 1) * per) - s * per for s in range(self.n_shards) if s * per < n]
+
+    # ----------------------------------------------------------------- query
+    def _base_order(self, q: np.ndarray, k: int) -> list[int]:
+        if self._shards:
+            import jax.numpy as jnp
+
+            qd = jnp.asarray(q)
+            merged: list[tuple[float, int]] = []
+            for off, shard in zip(self._offsets, self._shards):
+                kk = min(k, int(shard.shape[0]))
+                scores, idx = _topk_scores(shard, qd, k=kk)
+                merged.extend(
+                    (float(s), off + int(i))
+                    for s, i in zip(np.asarray(scores), np.asarray(idx))
+                )
+        else:
+            if self._table_np is None:
+                return []
+            merged = self._host_shard_candidates(q, k)
+        # Host-side merge: score descending, global row ascending on ties
+        # (deterministic regardless of shard arrival order).
+        merged.sort(key=lambda t: (-t[0], t[1]))
+        return [r for _, r in merged[:k]]
+
+    def _host_shard_candidates(self, q: np.ndarray, k: int) -> list[tuple[float, int]]:
+        n = self._table_np.shape[0]
+        per = -(-n // self.n_shards)
+        out: list[tuple[float, int]] = []
+        for s in range(self.n_shards):
+            lo, hi = s * per, min(n, (s + 1) * per)
+            if lo >= hi:
+                break
+            scores = self._table_np[lo:hi] @ q
+            kk = min(k, hi - lo)
+            part = np.argpartition(scores, -kk)[-kk:]
+            out.extend((float(scores[i]), lo + int(i)) for i in part)
+        return out

@@ -656,6 +656,58 @@ class TracingConfig:
 
 
 @dataclass
+class ClusterConfig:
+    """Multi-replica engine pool (mcpx/cluster/): N ``InferenceEngine``
+    replicas behind one engine-shaped facade, with a scored routing
+    pipeline (queue/ETA baseline, prefix-locality affinity, cost/burn-aware
+    placement) and replica lifecycle (spawn/warm/drain/kill/rejoin). Off by
+    default: with ``enabled=false`` the factory builds the single bare
+    engine exactly as before — byte-identical pass-through."""
+
+    enabled: bool = False
+    # Engine replicas the pool spawns at startup.
+    replicas: int = 2
+    # --- routing pipeline ------------------------------------------------
+    # Prefix-locality affinity: rendezvous hash over the radix prefix of
+    # the rendered prompt ids, so repeat traffic lands on the replica whose
+    # tree already holds its KV (grammar-slot residency breaks ties).
+    affinity: bool = True
+    # Leading prompt tokens forming the affinity key, truncated down to a
+    # KV-page boundary so the key is stable across small suffix edits.
+    affinity_prefix_tokens: int = 64
+    # Weight of the affinity bonus against the queue/ETA baseline score.
+    affinity_weight: float = 1.0
+    # Load-imbalance escape hatch: the affinity bonus is dropped once the
+    # preferred replica's queue depth exceeds ratio x (min depth + 1).
+    imbalance_ratio: float = 4.0
+    # Cost/burn-aware placement: steer fast-burning tenants (SLO budget
+    # burn + ledger spend share) toward the pool's degraded tail so
+    # healthy replicas keep serving budget-healthy traffic.
+    burn_aware: bool = False
+    # --- scoreboard ------------------------------------------------------
+    # Off-request-path health refresh cadence (queue depth/ETA, service
+    # EWMA, error rate) feeding routing, GET /cluster and mcpx_cluster_*.
+    scoreboard_interval_s: float = 0.5
+    # Rolling per-replica outcome window behind the breaker-adjacent
+    # error rate on the scoreboard.
+    error_window: int = 32
+    # --- lifecycle -------------------------------------------------------
+    # Drain: stop routing, wait up to this long for in-flight rows, close.
+    drain_timeout_s: float = 10.0
+    # Warm-up path: per-replica warm-restart KV snapshots land at
+    # <dir>/replica-<i>.json; a rejoining replica restores its manifest
+    # before taking traffic. Requires engine.kv_tier.enabled.
+    warm_snapshot_dir: str = ""
+    # --- registry sharding ----------------------------------------------
+    # Partition the retrieval embedding table row-wise with shard-local
+    # top-k merged host-side (100k-service registries stop fitting one
+    # replica's HBM comfortably).
+    shard_registry: bool = False
+    # Shard count; 0 = one shard per replica.
+    registry_shards: int = 0
+
+
+@dataclass
 class MCPXConfig:
     server: ServerConfig = field(default_factory=ServerConfig)
     tracing: TracingConfig = field(default_factory=TracingConfig)
@@ -669,6 +721,7 @@ class MCPXConfig:
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     orchestrator: OrchestratorConfig = field(default_factory=OrchestratorConfig)
     planner: PlannerConfig = field(default_factory=PlannerConfig)
+    cluster: ClusterConfig = field(default_factory=ClusterConfig)
 
     # ------------------------------------------------------------------ load
     @classmethod
@@ -973,6 +1026,38 @@ class MCPXConfig:
             problems.append(
                 f"retrieval.shortlist_mode '{self.retrieval.shortlist_mode}' "
                 "not in residual|topk"
+            )
+        cl = self.cluster
+        if cl.replicas < 1:
+            problems.append("cluster.replicas must be >= 1")
+        if cl.affinity_prefix_tokens < 1:
+            problems.append("cluster.affinity_prefix_tokens must be >= 1")
+        if cl.affinity_weight < 0:
+            problems.append("cluster.affinity_weight must be >= 0")
+        if cl.imbalance_ratio < 1.0:
+            problems.append("cluster.imbalance_ratio must be >= 1")
+        if cl.scoreboard_interval_s <= 0:
+            problems.append("cluster.scoreboard_interval_s must be > 0")
+        if cl.error_window < 1:
+            problems.append("cluster.error_window must be >= 1")
+        if cl.drain_timeout_s < 0:
+            problems.append("cluster.drain_timeout_s must be >= 0")
+        if cl.registry_shards < 0:
+            problems.append("cluster.registry_shards must be >= 0 (0 = one per replica)")
+        if cl.enabled and self.planner.kind != "llm":
+            problems.append(
+                "cluster.enabled requires planner.kind=llm (the pool owns "
+                "inference-engine replicas; heuristic/mock planners have none)"
+            )
+        if cl.burn_aware and not so.enabled:
+            problems.append(
+                "cluster.burn_aware requires slo.enabled (placement reads "
+                "the error-budget engine's burn state)"
+            )
+        if cl.warm_snapshot_dir and not kt.enabled:
+            problems.append(
+                "cluster.warm_snapshot_dir requires engine.kv_tier.enabled "
+                "(replica warm-up restores manifests into the host spill tier)"
             )
         if problems:
             raise ConfigError("; ".join(problems))

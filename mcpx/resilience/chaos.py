@@ -23,7 +23,13 @@ Profile schema (docs/resilience.md):
           "flap_down_s": 3             // ...it is DOWN for this long
         }
       },
-      "default": { ... }               // faults for unmatched endpoints
+      "default": { ... },              // faults for unmatched endpoints
+      "cluster": {                     // replica-pool faults (mcpx/cluster/):
+        "replica": 1,                  // pool slot to kill (clamped to pool)
+        "at_s": 2.0,                   // kill this long after pool start
+        "down_s": 3.0,                 // stay dead this long...
+        "rejoin": true                 // ...then rejoin (warm-restart path)
+      }
     }
 
 Determinism: all draws come from one seeded RNG consumed in a fixed order
@@ -76,6 +82,33 @@ class EndpointFaults:
         return f
 
 
+@dataclass
+class ClusterFaults:
+    """Kill-a-replica / rejoin schedule consumed by the engine pool
+    (mcpx/cluster/pool.py) — the ChaosTransport never sees it; replica
+    loss is an ENGINE fault, not a microservice fault."""
+
+    replica: int = 0
+    at_s: float = 0.0
+    down_s: float = 0.0
+    rejoin: bool = True
+
+    @classmethod
+    def from_dict(cls, obj: dict[str, Any]) -> "ClusterFaults":
+        known = set(cls.__dataclass_fields__)
+        for k in obj:
+            if k not in known:
+                raise ConfigError(f"chaos profile: unknown key '{k}' in cluster")
+        f = cls(**obj)
+        if f.replica < 0:
+            raise ConfigError("chaos profile: cluster.replica must be >= 0")
+        if f.at_s < 0 or f.down_s < 0:
+            raise ConfigError(
+                "chaos profile: cluster.at_s and cluster.down_s must be >= 0"
+            )
+        return f
+
+
 class ChaosProfile:
     def __init__(
         self,
@@ -83,16 +116,18 @@ class ChaosProfile:
         seed: int = 0,
         endpoints: Optional[dict[str, EndpointFaults]] = None,
         default: Optional[EndpointFaults] = None,
+        cluster: Optional[ClusterFaults] = None,
     ) -> None:
         self.seed = seed
         self.endpoints = endpoints or {}
         self.default = default
+        self.cluster = cluster
 
     @classmethod
     def from_dict(cls, obj: dict[str, Any]) -> "ChaosProfile":
         if not isinstance(obj, dict):
             raise ConfigError("chaos profile must be a JSON object")
-        known = {"seed", "endpoints", "default"}
+        known = {"seed", "endpoints", "default", "cluster"}
         for k in obj:
             if k not in known:
                 raise ConfigError(f"chaos profile: unknown top-level key '{k}'")
@@ -105,7 +140,15 @@ class ChaosProfile:
             if obj.get("default")
             else None
         )
-        return cls(seed=int(obj.get("seed", 0)), endpoints=endpoints, default=default)
+        cluster = (
+            ClusterFaults.from_dict(obj["cluster"]) if obj.get("cluster") else None
+        )
+        return cls(
+            seed=int(obj.get("seed", 0)),
+            endpoints=endpoints,
+            default=default,
+            cluster=cluster,
+        )
 
     @classmethod
     def from_file(cls, path: str) -> "ChaosProfile":

@@ -85,7 +85,7 @@ _LIMITED = metrics_mod.LIMITED_ENDPOINTS
 _UNTRACED = {
     "/metrics", "/costs", "/cache", "/traces", "/traces/{trace_id}",
     "/healthz", "/telemetry", "/debug/anomalies",
-    "/debug/anomalies/{bundle_id}", "/usage", "/slo",
+    "/debug/anomalies/{bundle_id}", "/usage", "/slo", "/cluster",
 }
 
 # Request key the /plan handler uses to tell the middleware's SLO observe
@@ -711,8 +711,19 @@ def build_app(cp: ControlPlane) -> web.Application:
     app.router.add_get("/traces/{trace_id}", trace_get)
     app.router.add_get("/debug/anomalies", anomalies_handler)
     app.router.add_get("/debug/anomalies/{bundle_id}", anomaly_bundle_handler)
+    async def cluster_handler(request: web.Request) -> web.Response:
+        """Replica-pool scoreboard (mcpx/cluster/, docs/cluster.md):
+        per-replica lifecycle/depth/ETA/error-rate rows, routing tallies
+        and the last routing decision. Disabled-subsystem convention:
+        {"enabled": false}, not a 404 (same as /usage and /slo)."""
+        pool = getattr(cp, "cluster", None)
+        if pool is None:
+            return web.json_response({"enabled": False})
+        return web.json_response(pool.scoreboard_snapshot())
+
     app.router.add_get("/usage", usage_handler)
     app.router.add_get("/slo", slo_handler)
+    app.router.add_get("/cluster", cluster_handler)
     app.router.add_get("/telemetry", telemetry_handler)
     app.router.add_get("/healthz", healthz)
     app.router.add_post("/profile/start", profile_start)
@@ -749,10 +760,25 @@ def build_app(cp: ControlPlane) -> web.Application:
             # stack already exposes; bundle writes happen off the loop
             # inside the recorder (asyncio.to_thread).
             startup_task["flight"] = asyncio.create_task(cp.flight.run())
+        if getattr(cp, "cluster", None) is not None:
+            # Cluster scoreboard refresh: per-replica health pulled OFF the
+            # request path (routing scores read the cached snapshots).
+            startup_task["cluster"] = asyncio.create_task(
+                cp.cluster.run_scoreboard()
+            )
 
     app.on_startup.append(on_startup)
 
     async def on_cleanup(app: web.Application) -> None:
+        cl = startup_task.pop("cluster", None)
+        if cl is not None:
+            cl.cancel()
+            try:
+                await cl
+            except asyncio.CancelledError:
+                pass  # the cancel above landing, not a failure
+            except Exception:
+                log.exception("cluster scoreboard loop died with an error")
         fl = startup_task.pop("flight", None)
         if fl is not None:
             fl.cancel()

@@ -128,6 +128,14 @@ class ControlPlane:
             jax=_jax_version(),
             backend=_backend_label(self.config),
         )
+        # Cluster pool (mcpx/cluster/): present iff the factory wrapped the
+        # planner's engine in an EnginePool. The pool's burn-aware placement
+        # reads the ledger/SLO built just above — they don't exist yet when
+        # the factory constructs the pool, so the signals late-bind here.
+        _eng = getattr(self.planner, "engine", None)
+        self.cluster = _eng if hasattr(_eng, "scoreboard_snapshot") else None
+        if self.cluster is not None:
+            self.cluster.attach_signals(slo=self.slo, ledger=self.ledger)
         # Flight recorder & anomaly observatory (mcpx/telemetry/flight.py):
         # the always-on telemetry timeseries + SPC detectors + diagnostic
         # bundles. None while telemetry.flight.enabled=false — the serving

@@ -44,7 +44,18 @@ def build_control_plane(
             )
             RetrievalIndex = None
         if RetrievalIndex is not None:
-            retriever = RetrievalIndex(config.retrieval)
+            if config.cluster.enabled and config.cluster.shard_registry:
+                # Registry sharding (docs/cluster.md): row-partitioned
+                # embedding table, shard-local top-k merged host-side.
+                from mcpx.cluster.sharding import ShardedRetrievalIndex
+
+                retriever = ShardedRetrievalIndex(
+                    config.retrieval,
+                    n_shards=config.cluster.registry_shards
+                    or config.cluster.replicas,
+                )
+            else:
+                retriever = RetrievalIndex(config.retrieval)
             if config.retrieval.snapshot_path:
                 try:
                     retriever.load(config.retrieval.snapshot_path)
@@ -69,16 +80,18 @@ def build_control_plane(
             ttl_s=config.planner.plan_cache_redis_ttl_s,
         )
     metrics = Metrics()
+    chaos_profile = None
     if config.resilience.chaos_profile:
         # Chaos injection (`mcpx serve --chaos profile.json`): every
         # microservice call crosses the seeded fault injector. Wrapped
         # OUTSIDE the resilience gate on purpose — the bench measures the
-        # same fault profile with resilience on vs off.
+        # same fault profile with resilience on vs off. The profile's
+        # optional "cluster" section is NOT a transport fault — the engine
+        # pool consumes it below (kill-a-replica / rejoin schedule).
         from mcpx.resilience.chaos import ChaosProfile, ChaosTransport
 
-        transport = ChaosTransport(
-            transport, ChaosProfile.from_file(config.resilience.chaos_profile)
-        )
+        chaos_profile = ChaosProfile.from_file(config.resilience.chaos_profile)
+        transport = ChaosTransport(transport, chaos_profile)
     resilience = None
     if config.resilience.enabled:
         from mcpx.resilience import Resilience
@@ -106,7 +119,24 @@ def build_control_plane(
                 from mcpx.core.errors import ConfigError
 
                 raise ConfigError(f"planner.kind=llm unavailable: {e}") from e
-            planner = LLMPlanner.from_config(config, retriever=retriever, metrics=metrics)
+            if config.cluster.enabled:
+                # Cluster layer (mcpx/cluster/): N engine replicas behind
+                # the same duck-typed surface a bare engine exposes, so the
+                # scheduler/app/flight wiring below is untouched. Disabled
+                # (the default) takes the from_config path — byte-identical
+                # single-engine pass-through.
+                from mcpx.cluster import EnginePool
+
+                pool = EnginePool(
+                    config,
+                    metrics=metrics,
+                    chaos=chaos_profile.cluster if chaos_profile else None,
+                )
+                planner = LLMPlanner(pool, config.planner)
+            else:
+                planner = LLMPlanner.from_config(
+                    config, retriever=retriever, metrics=metrics
+                )
     scheduler = None
     if config.scheduler.enabled:
         from mcpx.scheduler import Scheduler

@@ -356,6 +356,15 @@ _DETECTOR_SPECS: tuple[dict, ...] = (
     # a window) = sample skipped, recorder-off parity untouched.
     dict(name="slo_burn", signal="slo_fast_burn", direction="high",
          floor=14.4),
+    # One hot replica (mcpx/cluster/): max-over-mean queue load across the
+    # pool's routable replicas. A balanced pool sits at ~1.0 whatever the
+    # offered load, so the floor demands the hottest replica carry at
+    # least 2x the mean before a bundle can trip (affinity legitimately
+    # concentrates a little; a wedged replica concentrates a lot). Signal
+    # absent while no pool serves (cluster.enabled=false) = sample
+    # skipped — recorder-off parity untouched.
+    dict(name="replica_skew", signal="replica_skew", direction="high",
+         floor=2.0),
 )
 
 
@@ -539,7 +548,7 @@ class FlightRecorder:
         for key in (
             "queue_depth", "active_rows", "eta_s", "hol_wait_ms",
             "prefix_hit_rate", "breakers_open", "sched_degraded",
-            "slo_fast_burn",
+            "slo_fast_burn", "replica_skew",
         ):
             if key in raw:
                 signals[key] = raw[key]
@@ -874,6 +883,12 @@ def build_flight_recorder(cp: Any) -> Optional["FlightRecorder"]:
             fb = slo.fast_burn()
             if fb is not None:
                 raw["slo_fast_burn"] = float(fb)
+        pool = getattr(cp, "cluster", None)
+        if pool is not None:
+            # Replica-pool balance (mcpx/cluster/): the replica_skew
+            # detector's watch — one hot replica trips a bundle carrying
+            # the scoreboard that names it.
+            raw["replica_skew"] = float(pool.replica_skew())
         return raw
 
     def traces_source() -> list[dict]:
@@ -921,6 +936,11 @@ def build_flight_recorder(cp: Any) -> Optional["FlightRecorder"]:
     ledger = getattr(cp, "ledger", None)
     if ledger is not None:
         sources["usage"] = ledger.snapshot
+    pool = getattr(cp, "cluster", None)
+    if pool is not None:
+        # A replica_skew bundle names the hot replica: the scoreboard rides
+        # along (per-replica depth/ETA/error-rate/lifecycle rows).
+        sources["cluster"] = pool.scoreboard_snapshot
     specs = _DETECTOR_SPECS
     if slo is not None:
         # The slo_burn floor follows the CONFIGURED page threshold — a

@@ -443,6 +443,59 @@ class Metrics:
             ["objective", "window"],
             registry=self.registry,
         )
+        # Cluster (mcpx/cluster/): per-replica scoreboard gauges refreshed
+        # by the pool's off-request-path scoreboard loop, plus routing
+        # counters incremented at grant-route time. The "replica" label is
+        # the pool slot index — bounded by cluster.replicas, never by
+        # traffic.
+        self.cluster_replicas_ready = Gauge(
+            "mcpx_cluster_replicas_ready",
+            "Engine replicas currently routable (pool state 'ready')",
+            registry=self.registry,
+        )
+        self.cluster_replica_state = Gauge(
+            "mcpx_cluster_replica_state",
+            "Pool-side replica lifecycle (0=dead 1=spawning/warming "
+            "2=draining 3=ready)",
+            ["replica"],
+            registry=self.registry,
+        )
+        self.cluster_replica_depth = Gauge(
+            "mcpx_cluster_replica_depth",
+            "Replica queue depth incl. pool-tracked in-flight routes",
+            ["replica"],
+            registry=self.registry,
+        )
+        self.cluster_replica_eta = Gauge(
+            "mcpx_cluster_replica_eta_seconds",
+            "Replica admission ETA from its queue_stats snapshot",
+            ["replica"],
+            registry=self.registry,
+        )
+        self.cluster_replica_skew = Gauge(
+            "mcpx_cluster_replica_skew",
+            "Max-over-mean queue load across routable replicas (1.0 = "
+            "balanced); the flight recorder's replica_skew signal",
+            registry=self.registry,
+        )
+        self.cluster_routed = Counter(
+            "mcpx_cluster_routed_requests_total",
+            "Generate requests routed to each replica",
+            ["replica"],
+            registry=self.registry,
+        )
+        self.cluster_affinity_hits = Counter(
+            "mcpx_cluster_affinity_hits_total",
+            "Routed requests that landed on their prefix-affinity replica",
+            ["replica"],
+            registry=self.registry,
+        )
+        self.cluster_resteers = Counter(
+            "mcpx_cluster_resteers_total",
+            "Requests re-routed to a surviving replica after their first "
+            "choice died mid-request",
+            registry=self.registry,
+        )
         # Scheduler (mcpx/scheduler/): admission decisions, queue wait, and
         # ladder state. outcome: admitted | degraded (admitted but routed to
         # the shortlist planner by the degradation ladder) | shed_rate |

@@ -81,6 +81,7 @@ def _stats(**overrides):
         "flight": None,
         "ledger": None,
         "kernel": None,
+        "cluster": None,
         "pallas_paths": {
             "enabled": True,
             "interpret": True,
@@ -130,6 +131,13 @@ def test_output_schema_carries_roofline_pallas_reason_and_verdict():
         "kernel", "decode_dispatches_per_token",
         "decode_dispatches_per_token_per_step", "fused_decode_speedup",
         "pallas_paths",
+        # ISSUE 16: the cluster phase block, its promoted scaling /
+        # failover / affinity / warm-rejoin keys, and the measurement
+        # basis scenario dimension (ROADMAP item 4).
+        "cluster", "cluster_scaling_linearity",
+        "cluster_p99_one_down_ratio", "cluster_routed_token_hit_rate",
+        "cluster_rr_token_hit_rate", "cluster_affinity_hit_margin",
+        "cluster_warm_rejoin_prefill_ratio", "measurement_basis",
     ):
         assert key in out, key
     # ISSUE 7 fields: the roofline block…
@@ -242,6 +250,95 @@ def test_output_promotes_kernel_phase_acceptance_keys():
     assert out["kernel"] is None
     assert out["decode_dispatches_per_token"] is None
     assert out["fused_decode_speedup"] is None
+
+
+def test_output_promotes_cluster_phase_acceptance_keys():
+    """ISSUE 16: when the cluster phase ran, its scaling / failover /
+    affinity / warm-rejoin acceptance numbers are promoted to the top
+    level for TRACKED_METRICS regression tracking."""
+    cluster = {
+        "basis": {"scaling": "router-sim", "warm_rejoin": "interpret-kernel"},
+        "plans_per_sec": {"1": 190.0, "2": 380.0, "4": 760.0},
+        "cluster_scaling_linearity": 0.98,
+        "one_down": {"p99_ms_baseline": 28.0, "p99_ms_one_down": 41.0,
+                     "failures": 0, "resteered": 3, "rejoin_generation": 1},
+        "cluster_p99_one_down_ratio": 1.46,
+        "cluster_routed_token_hit_rate": 0.79,
+        "cluster_rr_token_hit_rate": 0.31,
+        "cluster_affinity_hit_margin": 0.48,
+        "warm_rejoin": {"prefill_ratio": 8.0, "parity_ok": True},
+        "cluster_warm_rejoin_prefill_ratio": 8.0,
+    }
+    out = bench._output_json(_stats(cluster=cluster), None, "test")
+    assert out["cluster"]["one_down"]["failures"] == 0
+    assert out["cluster_scaling_linearity"] == 0.98
+    assert out["cluster_p99_one_down_ratio"] == 1.46
+    assert out["cluster_routed_token_hit_rate"] == 0.79
+    assert out["cluster_rr_token_hit_rate"] == 0.31
+    assert out["cluster_affinity_hit_margin"] == 0.48
+    assert out["cluster_warm_rejoin_prefill_ratio"] == 8.0
+    # Skipped phase: block and promoted keys null, never absent.
+    out = bench._output_json(_stats(), None, "test")
+    assert out["cluster"] is None
+    assert out["cluster_scaling_linearity"] is None
+    assert out["cluster_routed_token_hit_rate"] is None
+    assert out["cluster_warm_rejoin_prefill_ratio"] is None
+
+
+def test_measurement_basis_labels_the_platform(monkeypatch):
+    """ROADMAP item 4: the output JSON carries an explicit measurement
+    basis — real-TPU / interpret-kernel / jnp-proxy — derived from the
+    platform and the kernel route."""
+    monkeypatch.setattr(bench, "_on_tpu", lambda: False)
+    monkeypatch.delenv("MCPX_BENCH_PALLAS", raising=False)
+    assert bench._measurement_basis() == "interpret-kernel"
+    monkeypatch.setenv("MCPX_BENCH_PALLAS", "0")
+    assert bench._measurement_basis() == "jnp-proxy"
+    monkeypatch.delenv("MCPX_BENCH_PALLAS")
+    monkeypatch.setattr(bench, "_on_tpu", lambda: True)
+    assert bench._measurement_basis() == "real-TPU"
+    monkeypatch.setattr(bench, "_on_tpu", lambda: False)
+    out = bench._output_json(_stats(), None, "test")
+    assert out["measurement_basis"] == "interpret-kernel"
+
+
+def test_report_scenario_splits_on_measurement_basis():
+    """A measurement-basis change (e.g. r09's jnp-proxy ->
+    interpret-kernel switch) reads as a NEW scenario: prior runs on the
+    old basis are excluded, not compared."""
+    prior = [
+        (f"a{i}", _mk_run(10.0, 100.0, measurement_basis="jnp-proxy"))
+        for i in range(3)
+    ]
+    shifted = ("z", _mk_run(30.0, 30.0, measurement_basis="interpret-kernel"))
+    rep = build_report([*prior, shifted])
+    assert rep["verdict"] == "no_comparable_series"
+    assert set(rep["excluded_scenario_mismatch"]) == {"a0", "a1", "a2"}
+    # Same basis compares as before.
+    same = ("z2", _mk_run(9.9, 101.0, measurement_basis="jnp-proxy"))
+    rep = build_report([*prior, same])
+    assert rep["verdict"] == "ok"
+    assert set(rep["compared_against"]) == {"a0", "a1", "a2"}
+
+
+def test_unwrap_derives_basis_for_pre_r10_artifacts(tmp_path):
+    """Artifacts predating the measurement_basis field get it derived from
+    what they recorded: TPU backend -> real-TPU; pallas + pallas_paths
+    (the r09 interpreter round) -> interpret-kernel; else jnp-proxy."""
+    from mcpx.cli.bench_report import _derive_basis
+
+    assert _derive_basis(_mk_run(1.0, 1.0, backend="tpu")) == "real-TPU"
+    assert _derive_basis(
+        _mk_run(1.0, 1.0, pallas=True, pallas_paths={"enabled": True})
+    ) == "interpret-kernel"
+    assert _derive_basis(_mk_run(1.0, 1.0, pallas=False)) == "jnp-proxy"
+    assert _derive_basis(_mk_run(1.0, 1.0)) == "jnp-proxy"
+    # load_runs backfills through _unwrap, so scenario keying never
+    # wildcards across a basis change.
+    p = tmp_path / "BENCH_r05.json"
+    p.write_text(json.dumps(_mk_run(10.0, 100.0, pallas=False)))
+    runs = load_runs([str(p)])
+    assert runs[0][1]["measurement_basis"] == "jnp-proxy"
 
 
 def test_output_promotes_ledger_phase_acceptance_keys():
