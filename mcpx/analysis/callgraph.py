@@ -52,22 +52,27 @@ _REQUEST_PAYLOAD_RE = re.compile(r"#\s*mcpx:\s*request-payload\b")
 _UNWRAP_NAMES = {"Optional", "ClassVar", "Final", "Annotated"}
 # Methods that pull one element out of a container-typed receiver.
 _ELEMENT_GETTERS = {"get", "get_nowait", "pop", "popleft", "popitem"}
-# Spawn-shaped module-level callables -> how the target callable is named.
+# Spawn-shaped module-level callables -> (how the target callable is
+# named, which execution context the target lands in). ``via`` is the
+# mechanism class: "thread" targets leave the event loop (threads,
+# executors), "loop" targets are scheduled back onto it (tasks and loop
+# callbacks — call_soon_threadsafe schedules ON the loop even though the
+# *call site* may be off it).
 _SPAWN_CALLS = {
-    "threading.Thread": "target",
-    "Thread": "target",
-    "asyncio.create_task": 0,
-    "asyncio.ensure_future": 0,
-    "asyncio.to_thread": 0,
+    "threading.Thread": ("target", "thread"),
+    "Thread": ("target", "thread"),
+    "asyncio.create_task": (0, "loop"),
+    "asyncio.ensure_future": (0, "loop"),
+    "asyncio.to_thread": (0, "thread"),
 }
-# Spawn-shaped methods (any receiver) -> positional index of the callable.
+# Spawn-shaped methods (any receiver) -> (positional index, via).
 _SPAWN_METHODS = {
-    "create_task": 0,
-    "call_soon_threadsafe": 0,
-    "call_soon": 0,
-    "call_later": 1,
-    "run_in_executor": 1,
-    "submit": 0,
+    "create_task": (0, "loop"),
+    "call_soon_threadsafe": (0, "loop"),
+    "call_soon": (0, "loop"),
+    "call_later": (1, "loop"),
+    "run_in_executor": (1, "thread"),
+    "submit": (0, "thread"),
 }
 
 
@@ -130,6 +135,7 @@ class Edge:
     kind: str  # "call" | "spawn"
     path: str
     line: int
+    via: str = ""  # spawn mechanism class: "thread" | "loop" ("" for calls)
 
 
 def module_name_for(relpath: str) -> str:
@@ -541,28 +547,36 @@ class CallGraph:
         self.edges: list[Edge] = []
         self._callers: dict[str, set[str]] = {}
         self._roots: dict[str, frozenset] = {}
+        self._spawned: dict[str, set[str]] = {}  # callee -> spawn vias
         for info in list(index.functions.values()):
             self._collect(info)
 
-    def _add(self, caller: str, callee: str, kind: str, path: str, line: int) -> None:
-        self.edges.append(Edge(caller, callee, kind, path, line))
+    def _add(
+        self, caller: str, callee: str, kind: str, path: str, line: int,
+        via: str = "",
+    ) -> None:
+        self.edges.append(Edge(caller, callee, kind, path, line, via))
         if kind == "call":
             self._callers.setdefault(callee, set()).add(caller)
+        else:
+            self._spawned.setdefault(callee, set()).add(via)
 
-    def _spawn_target(self, call: ast.Call) -> Optional[ast.AST]:
+    def _spawn_target(self, call: ast.Call) -> Optional[tuple]:
+        """(target expression, via) when ``call`` is a spawn dispatch."""
         cn = dotted_name(call.func)
         spec = _SPAWN_CALLS.get(cn or "")
-        if spec is None and isinstance(call.func, ast.Attribute):
+        if not spec and isinstance(call.func, ast.Attribute):
             spec = _SPAWN_METHODS.get(call.func.attr)
-        if spec is None:
+        if not spec:
             return None
-        if spec == "target":
+        pos, via = spec
+        if pos == "target":
             for kw in call.keywords:
                 if kw.arg == "target":
-                    return kw.value
-            return call.args[0] if call.args else None
-        if isinstance(spec, int) and spec < len(call.args):
-            return call.args[spec]
+                    return kw.value, via
+            return (call.args[0], via) if call.args else None
+        if isinstance(pos, int) and pos < len(call.args):
+            return call.args[pos], via
         return None
 
     def _collect(self, info: FunctionInfo) -> None:
@@ -571,8 +585,9 @@ class CallGraph:
         for node in ast.walk(info.node):
             if not isinstance(node, ast.Call):
                 continue
-            target = self._spawn_target(node)
-            if target is not None:
+            spawned = self._spawn_target(node)
+            if spawned is not None:
+                target, via = spawned
                 # create_task(f(...)) spawns the coroutine f builds; the
                 # inner f(...) call must not double as a plain call edge —
                 # its body runs in the spawned context.
@@ -583,7 +598,7 @@ class CallGraph:
                 if callee is not None:
                     self._add(
                         info.qualname, callee.qualname, "spawn",
-                        info.path, node.lineno,
+                        info.path, node.lineno, via,
                     )
                 continue
             if id(node) in spawn_inner:
@@ -596,6 +611,11 @@ class CallGraph:
 
     def callers_of(self, qualname: str) -> set:
         return set(self._callers.get(qualname, ()))
+
+    def spawned_via(self, qualname: str) -> frozenset:
+        """Mechanism classes ("thread"/"loop") this function is spawned
+        through anywhere in the project; empty if never a spawn target."""
+        return frozenset(self._spawned.get(qualname, ()))
 
     def roots_of(self, qualname: str) -> frozenset:
         """Terminal functions reachable by walking ``call`` edges backwards

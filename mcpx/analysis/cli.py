@@ -11,6 +11,10 @@ text mode prints one ``path:line rule-id message`` per finding.
 (``git diff HEAD`` + untracked), while the interprocedural passes still
 build their call graph over the full path set — diff-speed feedback,
 whole-program precision.
+
+``--fix`` rewrites the mechanical findings in place (unused/duplicate
+suppression ids, blank-line runs — see mcpx/analysis/fix.py);
+``--fix --dry-run`` prints the unified diff instead. Both exit 0.
 """
 
 from __future__ import annotations
@@ -68,6 +72,8 @@ def run_lint(
     rules: Optional[Iterable[str]] = None,
     root: Optional[str] = None,
     changed: bool = False,
+    fix: bool = False,
+    fix_dry_run: bool = False,
     out=None,
 ) -> int:
     out = out if out is not None else sys.stdout
@@ -102,6 +108,21 @@ def run_lint(
         from mcpx.analysis.core import _relpath
 
         changed_set = {_relpath(p, root_path) for p in selected}
+    if fix:
+        from mcpx.analysis.fix import apply_fixes
+
+        try:
+            return apply_fixes(
+                scan_targets,
+                root=root_path,
+                rules=list(rules) if rules is not None else None,
+                project_paths=project_paths,
+                dry_run=fix_dry_run,
+                out=out,
+            )
+        except ValueError as e:  # unknown --rule id, same contract as below
+            print(f"mcpxlint: error: {e}", file=out)
+            return 2
     try:
         result = scan_paths(
             scan_targets,

@@ -24,8 +24,16 @@ from mcpx.analysis.astutil import JIT_NAMES, dotted_name
 
 @dataclasses.dataclass
 class JitSpec:
-    """One jitted executable: where it was built, the impl it traces, and
-    the arg-name contracts the jit-contract pass verifies at call sites."""
+    """One jitted executable: where it was built, the impl it traces, the
+    arg-name contracts the jit-contract pass verifies at call sites, and
+    the sharding contract (parsed ``in_shardings``/``out_shardings``) the
+    sharding-contract pass verifies across executables.
+
+    Sharding encoding: ``None`` means the binding declares nothing (or
+    the expression was too dynamic to parse — unknowns never produce
+    findings); otherwise a tuple with one entry per argument/output, each
+    entry a parsed axis tuple (see ``_axes_of_spec``) or ``None`` when
+    that single position is unknown."""
 
     binding: str                      # last name segment calls use
     path: str
@@ -33,6 +41,8 @@ class JitSpec:
     static_argnames: frozenset
     donate_argnames: frozenset
     impl: Optional[FunctionInfo]      # resolved traced callable, if known
+    in_shardings: Optional[tuple] = None
+    out_shardings: Optional[tuple] = None
 
     def positional_param(self, i: int) -> Optional[str]:
         if self.impl is None:
@@ -41,6 +51,86 @@ class JitSpec:
         if self.impl.has_self and params:
             params = params[1:]
         return params[i] if i < len(params) else None
+
+
+_UNKNOWN = object()  # sentinel: axis expression too dynamic to parse
+_PSPEC_NAMES = {"P", "PartitionSpec"}
+
+
+def _axis_entry(a: ast.AST, resolve):
+    """One PartitionSpec element -> axis name (str), None (unsharded dim),
+    a tuple of names (dim sharded over several axes), or _UNKNOWN."""
+    if isinstance(a, ast.Constant):
+        if a.value is None:
+            return None
+        if isinstance(a.value, str):
+            return a.value
+        return _UNKNOWN
+    if isinstance(a, ast.Name):
+        t = resolve(a.id)
+        if isinstance(t, ast.Constant) and isinstance(t.value, str):
+            return t.value
+        return _UNKNOWN
+    if isinstance(a, (ast.Tuple, ast.List)):
+        parts = tuple(_axis_entry(e, resolve) for e in a.elts)
+        if any(p is _UNKNOWN for p in parts):
+            return _UNKNOWN
+        return parts
+    return _UNKNOWN
+
+
+def _axes_of_spec(expr: ast.AST, resolve) -> Optional[tuple]:
+    """Parse a sharding expression — ``P(...)``/``PartitionSpec(...)``,
+    ``NamedSharding(mesh, spec)``, ``None`` (replicated), or a Name bound
+    to one of those at module level — into a per-dimension axis tuple.
+    Returns None for anything dynamic: unknowns are skipped, not flagged."""
+    if isinstance(expr, ast.Constant) and expr.value is None:
+        return ()  # fully replicated
+    if isinstance(expr, ast.Name):
+        target = resolve(expr.id)
+        if target is None or isinstance(target, ast.Constant):
+            return None
+        return _axes_of_spec(target, resolve)
+    if isinstance(expr, ast.Call):
+        last = (dotted_name(expr.func) or "").rsplit(".", 1)[-1]
+        if last in _PSPEC_NAMES:
+            out = []
+            for a in expr.args:
+                ent = _axis_entry(a, resolve)
+                if ent is _UNKNOWN:
+                    return None
+                out.append(ent)
+            return tuple(out)
+        if last == "NamedSharding" and len(expr.args) >= 2:
+            return _axes_of_spec(expr.args[1], resolve)
+    return None
+
+
+def spec_axis_names(axes: Optional[tuple]):
+    """Flatten a parsed axis tuple to the set of mesh-axis names it uses."""
+    out: set = set()
+    if axes is None:
+        return out
+    for ent in axes:
+        if isinstance(ent, str):
+            out.add(ent)
+        elif isinstance(ent, tuple):
+            out.update(n for n in ent if isinstance(n, str))
+    return out
+
+
+def _shardings(call: ast.Call, key: str, resolve) -> Optional[tuple]:
+    """kwarg ``in_shardings=``/``out_shardings=`` -> per-position parsed
+    axis tuples (None entries where a position is unparseable); None when
+    the binding declares nothing."""
+    for kw in call.keywords:
+        if kw.arg != key:
+            continue
+        v = kw.value
+        if isinstance(v, (ast.Tuple, ast.List)):
+            return tuple(_axes_of_spec(e, resolve) for e in v.elts)
+        return (_axes_of_spec(v, resolve),)
+    return None
 
 
 def _str_names(call: ast.Call, key: str) -> frozenset:
@@ -68,6 +158,8 @@ class ProjectContext:
         self._graph: Optional[CallGraph] = None
         self._taint = None
         self._jit: Optional[dict] = None
+        self._mod_bindings: dict = {}
+        self._mesh_axes: Optional[frozenset] = None
 
     @property
     def index(self) -> ProjectIndex:
@@ -114,6 +206,70 @@ class ProjectContext:
             has_self=bool(params) and params[0] in ("self", "cls"),
         )
 
+    # ------------------------------------------------------------ sharding
+    def module_resolver(self, modname: str):
+        """Name -> module-level assigned expression, for resolving axis
+        constants (``DATA_AXIS = "data"``) and spec aliases
+        (``REPLICATED = P()``) while parsing sharding declarations."""
+        consts = self._mod_bindings.get(modname)
+        if consts is None:
+            consts = {}
+            mod = self.index.modules.get(modname)
+            for stmt in (mod.tree.body if mod is not None else ()):
+                if (
+                    isinstance(stmt, ast.Assign)
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                ):
+                    consts[stmt.targets[0].id] = stmt.value
+                elif (
+                    isinstance(stmt, ast.AnnAssign)
+                    and isinstance(stmt.target, ast.Name)
+                    and stmt.value is not None
+                ):
+                    consts[stmt.target.id] = stmt.value
+            self._mod_bindings[modname] = consts
+        return consts.get
+
+    def mesh_axes(self) -> frozenset:
+        """Union of axis names declared by every ``Mesh(devices,
+        axis_names)`` / ``make_mesh(..., axis_names)`` construction in the
+        project (axis-name Names resolved through module constants). The
+        sharding-contract pass only checks axis membership when this is
+        non-empty — a project with no mesh declares no contract."""
+        if self._mesh_axes is not None:
+            return self._mesh_axes
+        axes: set = set()
+        for mod in self.index.modules.values():
+            resolve = self.module_resolver(mod.name)
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                last = (dotted_name(node.func) or "").rsplit(".", 1)[-1]
+                if last not in ("Mesh", "AbstractMesh", "make_mesh"):
+                    continue
+                name_arg = None
+                for kw in node.keywords:
+                    if kw.arg == "axis_names":
+                        name_arg = kw.value
+                if name_arg is None and len(node.args) >= 2:
+                    name_arg = node.args[1]
+                if name_arg is None:
+                    continue
+                for sub in ast.walk(name_arg):
+                    if isinstance(sub, ast.Constant) and isinstance(
+                        sub.value, str
+                    ):
+                        axes.add(sub.value)
+                    elif isinstance(sub, ast.Name):
+                        t = resolve(sub.id)
+                        if isinstance(t, ast.Constant) and isinstance(
+                            t.value, str
+                        ):
+                            axes.add(t.value)
+        self._mesh_axes = frozenset(axes)
+        return self._mesh_axes
+
     # -------------------------------------------------------- jit bindings
     def jit_registry(self) -> dict:
         """binding name (last segment) -> list[JitSpec]. Bindings come from
@@ -136,6 +292,7 @@ class ProjectContext:
 
         for info in index.functions.values():
             env = index.local_env(info)
+            resolve = self.module_resolver(info.module)
             # jit-decorated def: binding is the function's own name.
             for dec in info.node.decorator_list:
                 call = dec if isinstance(dec, ast.Call) else None
@@ -148,6 +305,8 @@ class ProjectContext:
                             static_argnames=_str_names(call, "static_argnames"),
                             donate_argnames=_str_names(call, "donate_argnames"),
                             impl=info,
+                            in_shardings=_shardings(call, "in_shardings", resolve),
+                            out_shardings=_shardings(call, "out_shardings", resolve),
                         )
                     )
             for node in ast.walk(info.node):
@@ -169,6 +328,8 @@ class ProjectContext:
                             static_argnames=_str_names(call, "static_argnames"),
                             donate_argnames=_str_names(call, "donate_argnames"),
                             impl=impl,
+                            in_shardings=_shardings(call, "in_shardings", resolve),
+                            out_shardings=_shardings(call, "out_shardings", resolve),
                         )
                     )
         # Module-level `step = jax.jit(_step, ...)` assignments.
@@ -180,6 +341,7 @@ class ProjectContext:
                 path=mod.path,
                 node=ast.parse(""),  # placeholder; env below is empty
             )
+            resolve = self.module_resolver(mod.name)
             for stmt in mod.tree.body:
                 if not isinstance(stmt, ast.Assign):
                     continue
@@ -199,6 +361,8 @@ class ProjectContext:
                             static_argnames=_str_names(call, "static_argnames"),
                             donate_argnames=_str_names(call, "donate_argnames"),
                             impl=impl,
+                            in_shardings=_shardings(call, "in_shardings", resolve),
+                            out_shardings=_shardings(call, "out_shardings", resolve),
                         )
                     )
         self._jit = out

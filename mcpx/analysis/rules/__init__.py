@@ -8,9 +8,12 @@ from mcpx.analysis.rules import (  # noqa: F401
     io_rules,
     jax_rules,
     jit_contract_rules,
+    loop_rules,
     metrics_rules,
     ownership_rules,
     resilience_rules,
+    sharding_rules,
     style_rules,
     tracing_rules,
+    transfer_rules,
 )

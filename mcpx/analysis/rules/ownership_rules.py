@@ -47,6 +47,11 @@ _OWNER_RE = re.compile(
 )
 _CTOR_NAMES = {"__init__", "__post_init__", "__new__"}
 
+# The asyncio-loop ownership domain is checked by the `loop-confinement`
+# pass (loop_rules.py) with loop-specific terminal semantics; this pass
+# skips it so one annotation never double-reports.
+LOOP_DOMAIN = "event_loop"
+
 
 def _short(qualname: str) -> str:
     parts = qualname.split(".")
@@ -170,6 +175,17 @@ class _Ownership:
         return out
 
 
+def ownership_model(project) -> "_Ownership":
+    """The scan's shared ownership model (declarations + safety memo),
+    built once per ProjectContext — thread-ownership and loop-confinement
+    both read it."""
+    model = getattr(project, "_ownership_model", None)
+    if model is None:
+        model = _Ownership(project)
+        project._ownership_model = model
+    return model
+
+
 def _write_targets(node: ast.AST) -> Iterator[ast.AST]:
     """Flatten assignment/delete targets to the attribute/subscript nodes
     that name storage."""
@@ -197,7 +213,7 @@ def _attr_of_target(tgt: ast.AST) -> Optional[ast.Attribute]:
     scope="project",
 )
 def check_thread_ownership(project) -> Iterator[Finding]:
-    own = _Ownership(project)
+    own = ownership_model(project)
     index = own.index
     if not own.fields and not any(
         ci.owner for ci in index.classes.values()
@@ -252,7 +268,7 @@ def check_thread_ownership(project) -> Iterator[Finding]:
             cls = receiver_class(attr)
             decl = own.field_decl(cls, attr.attr)
             owner = decl[0] if decl else own.class_owner(cls)
-            if owner is None:
+            if owner is None or owner == LOOP_DOMAIN:
                 continue
             if in_ctor_of is not None and in_ctor_of == cls:
                 # construction-before-publication: the owning class's own
@@ -281,7 +297,9 @@ def check_thread_ownership(project) -> Iterator[Finding]:
                 continue
             cls = receiver_class(node)
             decl = own.field_decl(cls, node.attr)
-            if decl is None or decl[1]:  # undeclared or atomic
+            # Undeclared, atomic, or loop-domain (loop-confinement treats
+            # cross-boundary reads as the published GIL-atomic contract).
+            if decl is None or decl[1] or decl[0] == LOOP_DOMAIN:
                 continue
             owner = decl[0]
             if in_ctor_of is not None and cls == in_ctor_of:
@@ -304,7 +322,7 @@ def check_thread_ownership(project) -> Iterator[Finding]:
             if not isinstance(node, ast.Call):
                 continue
             callee = index.resolve_call(node, info, env)
-            if callee is None or not callee.owner:
+            if callee is None or not callee.owner or callee.owner == LOOP_DOMAIN:
                 continue
             owner = callee.owner
             ok, bad = own.safe_for(info, owner)

@@ -1,0 +1,226 @@
+"""sharding-contract: cross-executable consistency of sharding bindings.
+
+ROADMAP item 2 grows the registry to a 100k-service table sharded across
+real mesh slices; the retrace/reshard bugs that land with that work are
+cheap to prove statically NOW, while the registry is small. The jit
+registry (ProjectContext.jit_registry) records ``in_shardings``/
+``out_shardings``/``NamedSharding``/``PartitionSpec`` per executable and
+the project's declared mesh axes (every ``Mesh(devices, axis_names)`` /
+``make_mesh`` construction, axis-name constants resolved); this pass
+verifies three contracts:
+
+  - **Declared axes only.** An axis named in ``with_sharding_constraint``,
+    a ``NamedSharding`` construction or a jit sharding binding must appear
+    in some mesh declaration — a typo'd axis name fails at dispatch time
+    on real multichip topology but silently falls back to replication (or
+    tracing errors) in single-host tests. Only checked when the project
+    declares a mesh at all.
+  - **Producer/consumer agreement.** ``y = execA(...)`` followed by
+    ``execB(..., y, ...)`` where A's out-sharding and B's in-sharding for
+    that position name different axis layouts forces an implicit reshard
+    (an all-to-all on the hot path) on every call. Positions whose specs
+    did not parse, or bindings with multiple registry entries, are
+    skipped — unknowns never produce findings.
+  - **Donated buffers with live sharded aliases.** jit-contract flags a
+    donated *name* read after dispatch; on sharded executables an alias
+    (``alias = x`` ... ``execA(x)`` ... ``read(alias)``) observes the
+    same deleted device buffers — flagged when the executable both
+    donates and declares shardings.
+
+Everything here is best-effort parsing over module-level constants
+(``DATA_AXIS = "data"``, ``REPLICATED = P()``); dynamic specs resolve to
+unknown and are skipped, so the pass is quiet by construction where it
+cannot be precise.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from mcpx.analysis.core import Finding, rule
+from mcpx.analysis.project import _axes_of_spec, spec_axis_names
+from mcpx.analysis.rules.common import dotted_name
+
+
+def _unique_spec(registry: dict, binding: str):
+    specs = registry.get(binding)
+    return specs[0] if specs and len(specs) == 1 else None
+
+
+def _fmt(axes: Optional[tuple]) -> str:
+    if axes is None:
+        return "?"
+    return "P(" + ", ".join(
+        "None" if e is None else repr(e) for e in axes
+    ) + ")"
+
+
+@rule(
+    "sharding-contract",
+    "sharding binding names an undeclared mesh axis, a producer/consumer "
+    "executable pair disagrees on a buffer's sharding, or a donated "
+    "sharded buffer has a live alias after dispatch",
+    scope="project",
+)
+def check_sharding_contract(project) -> Iterator[Finding]:
+    index = project.index
+    registry = project.jit_registry()
+    declared = project.mesh_axes()
+    seen: set[tuple] = set()
+
+    def emit(path: str, line: int, key: tuple, msg: str):
+        if key in seen:
+            return None
+        seen.add(key)
+        return project.finding(path, line, "sharding-contract", msg)
+
+    # --- (a) every named axis must be declared by some mesh
+    if declared:
+        for spec_list in registry.values():
+            for spec in spec_list:
+                for kind, shardings in (
+                    ("in_shardings", spec.in_shardings),
+                    ("out_shardings", spec.out_shardings),
+                ):
+                    for axes in shardings or ():
+                        for ax in sorted(spec_axis_names(axes) - declared):
+                            f = emit(
+                                spec.path,
+                                spec.line,
+                                ("ax", spec.path, spec.line, ax),
+                                f"{kind} of jitted binding '{spec.binding}' "
+                                f"names mesh axis '{ax}' which no Mesh in "
+                                "the project declares "
+                                f"(declared: {sorted(declared)}) — a typo'd "
+                                "axis silently replicates on single-host "
+                                "and fails at dispatch on real topology",
+                            )
+                            if f:
+                                yield f
+        for mod in index.modules.values():
+            resolve = project.module_resolver(mod.name)
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                last = (dotted_name(node.func) or "").rsplit(".", 1)[-1]
+                spec_arg = None
+                if last == "with_sharding_constraint" and len(node.args) >= 2:
+                    spec_arg = node.args[1]
+                elif last == "NamedSharding" and len(node.args) >= 2:
+                    spec_arg = node.args[1]
+                if spec_arg is None:
+                    continue
+                axes = _axes_of_spec(spec_arg, resolve)
+                for ax in sorted(spec_axis_names(axes) - declared):
+                    f = emit(
+                        mod.path,
+                        node.lineno,
+                        ("ax", mod.path, node.lineno, ax),
+                        f"'{last}' names mesh axis '{ax}' which no Mesh in "
+                        f"the project declares (declared: {sorted(declared)})"
+                        " — constraint axes must come from the enclosing "
+                        "mesh declaration",
+                    )
+                    if f:
+                        yield f
+
+    # --- (b) producer out-sharding vs consumer in-sharding, (c) donated
+    # sharded buffers with live aliases — both walked per function.
+    for info in index.functions.values():
+        produced: dict[str, tuple] = {}  # local name -> (binding, axes, line)
+        aliases: dict[str, tuple] = {}   # alias -> (source name, line)
+        for node in ast.walk(info.node):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+            ):
+                tgt = node.targets[0].id
+                if isinstance(node.value, ast.Name):
+                    aliases[tgt] = (node.value.id, node.lineno)
+                elif isinstance(node.value, ast.Call):
+                    callee = dotted_name(node.value.func)
+                    binding = callee.rsplit(".", 1)[-1] if callee else None
+                    spec = _unique_spec(registry, binding or "")
+                    if (
+                        spec is not None
+                        and spec.out_shardings is not None
+                        and len(spec.out_shardings) == 1
+                        and spec.out_shardings[0] is not None
+                    ):
+                        produced[tgt] = (
+                            spec.binding, spec.out_shardings[0], node.lineno
+                        )
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted_name(node.func)
+            binding = callee.rsplit(".", 1)[-1] if callee else None
+            spec = _unique_spec(registry, binding or "")
+            if spec is None:
+                continue
+            # (b) consumer check
+            if spec.in_shardings is not None:
+                for i, arg in enumerate(node.args):
+                    if not isinstance(arg, ast.Name) or arg.id not in produced:
+                        continue
+                    if i >= len(spec.in_shardings):
+                        break
+                    want = spec.in_shardings[i]
+                    src, got, _ = produced[arg.id]
+                    if want is None or got is None or want == got:
+                        continue
+                    pname = spec.positional_param(i) or f"arg {i}"
+                    f = emit(
+                        info.path,
+                        node.lineno,
+                        ("pc", node.lineno, spec.binding, arg.id),
+                        f"'{arg.id}' is produced by '{src}' sharded "
+                        f"{_fmt(got)} but '{spec.binding}' declares "
+                        f"{_fmt(want)} for '{pname}' — every call pays an "
+                        "implicit reshard (all-to-all); align the specs or "
+                        "insert an explicit reshard once",
+                    )
+                    if f:
+                        yield f
+            # (c) donated sharded buffer, live alias after dispatch
+            if spec.donate_argnames and spec.in_shardings is not None:
+                donated: list = []
+                for i, arg in enumerate(node.args):
+                    pname = spec.positional_param(i)
+                    if pname in spec.donate_argnames and isinstance(
+                        arg, ast.Name
+                    ):
+                        donated.append(arg.id)
+                for kw in node.keywords:
+                    if kw.arg in spec.donate_argnames and isinstance(
+                        kw.value, ast.Name
+                    ):
+                        donated.append(kw.value.id)
+                for dname in donated:
+                    for alias, (src, aline) in aliases.items():
+                        if src != dname or aline >= node.lineno:
+                            continue
+                        for use in ast.walk(info.node):
+                            if (
+                                isinstance(use, ast.Name)
+                                and isinstance(use.ctx, ast.Load)
+                                and use.id == alias
+                                and use.lineno > node.lineno
+                            ):
+                                f = emit(
+                                    info.path,
+                                    use.lineno,
+                                    ("al", use.lineno, alias),
+                                    f"'{alias}' aliases '{dname}', which "
+                                    f"was donated to sharded executable "
+                                    f"'{spec.binding}' at line "
+                                    f"{node.lineno} — the alias now points "
+                                    "at deleted device buffers; drop the "
+                                    "alias or rebind it from the call's "
+                                    "outputs",
+                                )
+                                if f:
+                                    yield f
+                                break

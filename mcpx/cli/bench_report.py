@@ -89,6 +89,21 @@ DEFAULT_BAND = 0.25
 # flag 1% wiggles on a shared-core host.
 MIN_BAND = 0.05
 
+# Absolute noise floors for paired-difference fractions whose TRUE value
+# is ~0 (overhead of a feature vs. the same run without it, share of
+# requests past a deadline). A relative band is meaningless against a
+# near-zero median — r08..r10 flagged flight_overhead_frac "regressed"
+# for moving 0.018 -> 0.054 when both numbers are timer jitter. When the
+# latest value AND the prior median both sit within the floor of zero,
+# the metric reads ``ok`` regardless of the relative delta; a value that
+# ESCAPES its floor is judged by the usual band. Floors are calibrated
+# from the observed run-to-run scatter of the CPU-proxy series.
+NOISE_FLOORS: dict[str, float] = {
+    "flight_overhead_frac": 0.06,
+    "ledger_overhead_frac": 0.10,
+    "deadline_overrun_share": 0.02,
+}
+
 _SCENARIO_KEYS = (
     "model", "backend", "vocab", "quantize", "registry", "n_services",
     "measurement_basis",
@@ -185,7 +200,10 @@ def _band(priors: list[float]) -> float:
 
 
 def _metric_verdict(
-    latest: Optional[float], priors: list[float], direction: str
+    latest: Optional[float],
+    priors: list[float],
+    direction: str,
+    floor: Optional[float] = None,
 ) -> dict:
     if latest is None and not priors:
         return {"verdict": "missing"}
@@ -203,13 +221,17 @@ def _metric_verdict(
     band = _band(priors)
     delta = (latest - med) / abs(med) if med != 0 else (0.0 if latest == 0 else 1.0)
     worse = -delta if direction == "higher" else delta
-    if worse > band:
+    if floor is not None and abs(latest) <= floor and abs(med) <= floor:
+        # Both sides of the comparison are within the absolute noise
+        # floor of zero: the relative delta is jitter over jitter.
+        verdict = "ok"
+    elif worse > band:
         verdict = "regressed"
     elif -worse > band:
         verdict = "improved"
     else:
         verdict = "ok"
-    return {
+    mv = {
         "verdict": verdict,
         "latest": latest,
         "previous_median": med,
@@ -217,6 +239,9 @@ def _metric_verdict(
         "band_frac": round(band, 4),
         "n_priors": len(priors),
     }
+    if floor is not None:
+        mv["floor_abs"] = floor
+    return mv
 
 
 def build_report(
@@ -246,7 +271,10 @@ def build_report(
         priors = [
             v for v in (_get_path(r, path) for _, r in pool) if v is not None
         ]
-        mv = _metric_verdict(_get_path(latest, path), priors, direction)
+        mv = _metric_verdict(
+            _get_path(latest, path), priors, direction,
+            floor=NOISE_FLOORS.get(path),
+        )
         mv["direction"] = direction
         if basis_path is not None:
             mv["basis"] = _get_path_raw(latest, basis_path)
@@ -297,6 +325,8 @@ def render_text(report: dict) -> str:
             bits.append(f"prev_median={mv['previous_median']:g}")
         if "delta_frac" in mv:
             bits.append(f"delta={mv['delta_frac']:+.1%} band=±{mv['band_frac']:.1%}")
+        if "floor_abs" in mv:
+            bits.append(f"floor=±{mv['floor_abs']:g} abs")
         lines.append("  " + "  ".join(bits))
     return "\n".join(lines)
 

@@ -365,6 +365,8 @@ def cmd_lint(args: argparse.Namespace) -> int:
         fmt=args.format,
         rules=args.rule or None,
         changed=args.changed,
+        fix=args.fix,
+        fix_dry_run=args.dry_run,
     )
 
 
@@ -556,6 +558,15 @@ def main(argv: list[str] | None = None) -> int:
         "--changed", action="store_true",
         help="report only files modified vs HEAD (staged/unstaged/"
         "untracked); interprocedural passes still see the full path set",
+    )
+    p_lint.add_argument(
+        "--fix", action="store_true",
+        help="rewrite mechanical findings in place (unused/duplicate "
+        "suppression ids, blank-line runs) and exit 0",
+    )
+    p_lint.add_argument(
+        "--dry-run", action="store_true",
+        help="with --fix: print the unified diff without writing files",
     )
     p_lint.set_defaults(func=cmd_lint)
 

@@ -52,6 +52,7 @@ from mcpx.cluster.routing import (
     build_pipeline,
     rendezvous_choice,
 )
+from mcpx.utils.ownership import owned_by
 
 log = logging.getLogger("mcpx.cluster")
 
@@ -67,7 +68,14 @@ class ClusterPin:
         self.handle = handle
 
 
+@owned_by("event_loop")
 class EnginePool:
+    """Pool state is event-loop-confined (docstring above): the class-level
+    mark lets the ``loop-confinement`` pass prove every post-construction
+    mutation of pool/replica state is reachable only from loop-side entry
+    points (coroutines and loop callbacks, never ``to_thread``/executor
+    targets)."""
+
     def __init__(
         self,
         config: MCPXConfig,
@@ -79,11 +87,11 @@ class EnginePool:
     ) -> None:
         self.config = config
         self._metrics = metrics
-        self._pipeline = pipeline or build_pipeline(config)
+        self._pipeline: RoutingPipeline = pipeline or build_pipeline(config)
         self._chaos = chaos  # ClusterFaults (resilience/chaos.py) or None
         self._chaos_task: Optional[asyncio.Task] = None
-        self._closed = False
-        self.resteers = 0
+        self._closed = False  # mcpx: owner[event_loop]
+        self.resteers = 0  # mcpx: owner[event_loop]
         if engine_factory is None:
             from mcpx.engine.engine import InferenceEngine  # deferred: pulls in JAX
 

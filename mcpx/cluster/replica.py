@@ -17,6 +17,8 @@ from __future__ import annotations
 from collections import OrderedDict, deque
 from typing import Any, Optional
 
+from mcpx.utils.ownership import owned_by
+
 # Pool-side lifecycle. "ready" is the only routable state; "draining"
 # finishes in-flight rows but takes no new traffic; "dead" replicas keep
 # their slot (index identity matters for rendezvous hashing and for the
@@ -24,12 +26,13 @@ from typing import Any, Optional
 _ROUTABLE = ("ready",)
 
 
+@owned_by("event_loop")
 class ReplicaHandle:
     def __init__(self, index: int, engine: Any, *, error_window: int = 32) -> None:
         self.index = index
         self.engine = engine
         # Pool-side state: spawning -> warming -> ready -> draining -> dead.
-        self.state = "spawning"
+        self.state = "spawning"  # mcpx: owner[event_loop]
         # How many times this slot has been (re)joined — generation 0 is
         # the original spawn; each rejoin bumps it so the scoreboard and
         # GET /cluster can show churn.
@@ -58,7 +61,10 @@ class ReplicaHandle:
     def routable(self) -> bool:
         return self.state in _ROUTABLE and getattr(self.engine, "state", None) == "ready"
 
+    @owned_by("event_loop")
     def note_result(self, ok: bool) -> None:
+        # Marked: called only from EnginePool.generate (a coroutine) via
+        # a routing result the index can't type (Optional unwrap).
         self._outcomes.append(0 if ok else 1)
         if not ok:
             self.failed += 1
@@ -68,6 +74,7 @@ class ReplicaHandle:
             return 0.0
         return sum(self._outcomes) / len(self._outcomes)
 
+    @owned_by("event_loop")
     def note_grammar(self, key: Optional[int], *, cap: int = 16) -> None:
         if key is None:
             return

@@ -34,6 +34,7 @@ from dataclasses import dataclass, field
 from typing import Any, Optional, Sequence
 
 from mcpx.cluster.replica import ReplicaHandle
+from mcpx.utils.ownership import owned_by
 
 
 @dataclass
@@ -93,6 +94,7 @@ class QueueDepthPolicy:
         return out
 
 
+@owned_by("event_loop")
 class PrefixAffinityPolicy:
     name = "affinity"
 
@@ -110,8 +112,9 @@ class PrefixAffinityPolicy:
         self.imbalance_ratio = imbalance_ratio
         # Exposed for the pool's affinity-hit accounting: the replica this
         # policy preferred on the LAST score() call (None = hatch fired).
-        self.last_preferred: Optional[int] = None
+        self.last_preferred: Optional[int] = None  # mcpx: owner[event_loop]
 
+    @owned_by("event_loop")
     def score(
         self, req: RouteRequest, candidates: Sequence[ReplicaHandle]
     ) -> dict[int, float]:
@@ -149,6 +152,7 @@ class PrefixAffinityPolicy:
         return out
 
 
+@owned_by("event_loop")
 class CostBurnPolicy:
     name = "burn"
 
@@ -211,6 +215,7 @@ class CostBurnPolicy:
         return out
 
 
+@owned_by("event_loop")
 class RoundRobinPolicy:
     """Null-hypothesis router for the bench A/B: ignores everything and
     rotates. Strong enough (weight >> baseline) to dominate the pipeline
@@ -219,8 +224,9 @@ class RoundRobinPolicy:
     name = "round_robin"
 
     def __init__(self) -> None:
-        self._next = 0
+        self._next = 0  # mcpx: owner[event_loop]
 
+    @owned_by("event_loop")
     def score(
         self, req: RouteRequest, candidates: Sequence[ReplicaHandle]
     ) -> dict[int, float]:
@@ -229,12 +235,20 @@ class RoundRobinPolicy:
         return {r.index: (1000.0 if r.index == chosen else 0.0) for r in candidates}
 
 
+@owned_by("event_loop")
 class RoutingPipeline:
+    """Routing is loop-confined like the pool that drives it: ``route``
+    runs inside ``EnginePool.generate`` (a coroutine) and mutates policy
+    state (round-robin cursors, affinity last-preferred, the last-decision
+    echo) without locks. The method-level marks assert the loop domain at
+    the unresolved ``p.score(...)`` dispatch boundary."""
+
     def __init__(self, policies: Sequence[Any]) -> None:
         self.policies = list(policies)
         # Last decision, for GET /cluster ("why did this land there").
-        self.last_decision: dict[str, Any] = {}
+        self.last_decision: dict[str, Any] = {}  # mcpx: owner[event_loop]
 
+    @owned_by("event_loop")
     def route(
         self, req: RouteRequest, candidates: Sequence[ReplicaHandle]
     ) -> Optional[ReplicaHandle]:

@@ -27,8 +27,10 @@ import numpy as np
 
 from mcpx.core.config import RetrievalConfig
 from mcpx.retrieval.index import RetrievalIndex, _topk_scores
+from mcpx.utils.ownership import owned_by
 
 
+@owned_by("event_loop")
 class ShardedRetrievalIndex(RetrievalIndex):
     def __init__(
         self,
@@ -44,11 +46,17 @@ class ShardedRetrievalIndex(RetrievalIndex):
         self._offsets: list[int] = []  # global row of each shard's row 0
 
     # ------------------------------------------------------------- placement
+    @owned_by("event_loop")
     def _place(self, table: np.ndarray):
         """Split into near-equal contiguous row ranges and place each with
         the parent's sharding rule. Returns None: the full-table device
         copy is REPLACED by the shard list (``_base_order`` dispatches on
-        it), which also keeps the parent's host-mode branch intact."""
+        it), which also keeps the parent's host-mode branch intact.
+
+        Loop-owned (the marks): runtime rebuilds run in the parent's
+        async ``refresh`` under its lock; the sync startup ``load`` path
+        runs before the server publishes the index (construction-before-
+        publication, same argument as ctor writes)."""
         self._shards, self._offsets = [], []
         n = table.shape[0]
         per = -(-n // self.n_shards)  # ceil

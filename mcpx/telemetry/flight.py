@@ -56,6 +56,7 @@ import time
 from typing import Any, Callable, Optional
 
 from mcpx.telemetry.metrics import LIMITED_ENDPOINTS
+from mcpx.utils.ownership import owned_by
 
 log = logging.getLogger("mcpx.telemetry.flight")
 
@@ -401,8 +402,14 @@ def _quantile_from_buckets(
     return None
 
 
+@owned_by("event_loop")
 class FlightRecorder:
     """The always-on telemetry timeseries + anomaly observatory.
+
+    Loop-confined (the class-level mark): the ring, detector state and
+    bundle index are mutated only by the sampler task; cross-task readers
+    (``status()``) get GIL-atomic snapshots. Disk I/O runs via
+    ``asyncio.to_thread`` targets that touch no recorder state.
 
     ``collect`` returns one RAW sample (cheap GIL-atomic reads — counter
     values, gauge snapshots, histogram bucket vectors); the recorder

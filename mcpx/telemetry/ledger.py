@@ -44,6 +44,8 @@ import dataclasses
 import time
 from typing import Any, Optional
 
+from mcpx.utils.ownership import owned_by
+
 __all__ = [
     "RequestBill",
     "UsageLedger",
@@ -239,22 +241,25 @@ def deactivate(token: "contextvars.Token") -> None:
 _AGG_FIELDS = WALL_ITEMS + UNIT_ITEMS + COST_ITEMS + ("total_ms", "other_ms")
 
 
+@owned_by("event_loop")
 class UsageLedger:
     """Per-tenant usage roll-up. Event-loop confined (observe() runs in
-    the request middleware's finalize); ``snapshot()`` is a plain dict
-    build, safe from any task."""
+    the request middleware's finalize — the class-level mark plus the
+    mark on ``observe`` itself, whose middleware call site is a nested
+    def the index can't see); ``snapshot()`` is a plain dict build, safe
+    from any task."""
 
     def __init__(self, config: Any, metrics: Any = None) -> None:
         self.config = config
         self._metrics = metrics
         self.max_tenants = int(config.max_tenants)
-        self._tenants: dict[str, dict] = {}
+        self._tenants: dict[str, dict] = {}  # mcpx: owner[event_loop]
         # Bounded ring of recent finalized bills (tests/debug surface):
         # the conservation test checks tenant totals against these.
         self.recent: "collections.deque[dict]" = collections.deque(
             maxlen=max(0, int(config.recent))
         )
-        self.requests = 0
+        self.requests = 0  # mcpx: owner[event_loop]
 
     def fold(self, tenant: str) -> str:
         """Bounded tenant cardinality, the cache governor's discipline:
@@ -277,6 +282,7 @@ class UsageLedger:
             self._tenants[t] = acct
         return acct
 
+    @owned_by("event_loop")
     def observe(self, bill: RequestBill) -> None:
         """Fold one finalized bill into its tenant's aggregate, the recent
         ring, and the mcpx_ledger_* metric families. Plain ``+=`` in

@@ -41,6 +41,7 @@ import time
 from typing import Any, Callable, Optional
 
 from mcpx.telemetry.metrics import LATENCY_BUCKETS
+from mcpx.utils.ownership import owned_by
 
 __all__ = [
     "DEFAULT_OBJECTIVES",
@@ -116,12 +117,18 @@ class SLOObjective:
         return out
 
 
+@owned_by("event_loop")
 class SLOTracker:
     """Good/total event counts per (tenant, objective) in bounded time
     buckets; burn rates and budget remaining derived on read over the
     configured windows. Tenant cardinality folds at ``max_tenants`` (the
     cache governor's discipline); the global series is tracked under its
-    own key so it never depends on the fold."""
+    own key so it never depends on the fold.
+
+    Loop-confined (the class-level mark + the mark on ``observe``, whose
+    middleware call site is a nested def the index can't see): bucket
+    series are mutated only by ``observe`` on the serving loop; reads
+    are plain dict math over GIL-atomic snapshots."""
 
     GLOBAL = "__global__"
 
@@ -138,8 +145,8 @@ class SLOTracker:
         self.max_tenants = int(config.max_tenants)
         # tenant -> list of buckets [t_start, {obj_name: [good, total]}],
         # oldest first, pruned past the budget period on append.
-        self._buckets: dict[str, list] = {}
-        self.events = 0
+        self._buckets: dict[str, list] = {}  # mcpx: owner[event_loop]
+        self.events = 0  # mcpx: owner[event_loop]
 
     # -------------------------------------------------------------- observe
     def fold(self, tenant: str) -> str:
@@ -163,6 +170,7 @@ class SLOTracker:
             series.pop(0)
         return counts
 
+    @owned_by("event_loop")
     def observe(
         self,
         *,

@@ -1,0 +1,31 @@
+"""blocking-transfer negatives: off-loop readbacks, to_thread'd
+closures, and host-native values on the loop stay silent."""
+import asyncio
+
+import jax
+import numpy as np
+
+
+def _step(x):
+    return x
+
+
+jstep = jax.jit(_step)
+
+
+def offline_report(engine):
+    st = engine.queue_stats()
+    return float(st["depth"])
+
+
+async def handler(request, engine):
+    def _read():
+        return float(engine.queue_stats()["depth"])
+
+    depth = await asyncio.to_thread(_read)
+    n = float(len(request.tools))
+    return depth, n
+
+
+async def background(engine):
+    return np.asarray(jstep(1))

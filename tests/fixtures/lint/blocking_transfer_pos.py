@@ -1,0 +1,29 @@
+"""blocking-transfer positives: synchronizing device readbacks inside
+loop-side code — handler-direct, comprehension taint, and a sync
+helper one hop below an async request handler."""
+import jax
+import numpy as np
+
+
+def _step(x):
+    return x
+
+
+jstep = jax.jit(_step)
+
+
+async def handler(request, engine):
+    depth = float(engine.queue_stats()["depth"])
+    arr = jstep(request.payload)
+    host = np.asarray(arr)
+    vals = {k: float(v) for k, v in engine.queue_stats().items()}
+    return depth, host, vals
+
+
+def probe(engine):
+    st = engine.queue_stats()
+    return int(st["active"])
+
+
+async def poll(request, engine):
+    return probe(engine)

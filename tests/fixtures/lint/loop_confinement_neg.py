@@ -1,0 +1,47 @@
+"""loop-confinement negatives: coroutine writers, loop-spawned
+callbacks, ctor writes, marked mutators and cross-thread READS."""
+import asyncio
+import threading
+
+from mcpx.utils.ownership import owned_by
+
+
+@owned_by("event_loop")
+class Board:
+    def __init__(self):
+        self.depth = 0
+        self.seen = {}
+
+
+async def refresh(board: Board):
+    board.depth += 1
+
+
+def helper(board: Board):
+    board.seen["k"] = 1
+
+
+async def tick(board: Board):
+    helper(board)
+
+
+def on_loop(board: Board):
+    board.depth -= 1
+
+
+async def schedule(board: Board):
+    loop = asyncio.get_running_loop()
+    loop.call_soon(on_loop, board)
+
+
+@owned_by("event_loop")
+def marked_mutator(board: Board):
+    board.depth = 0
+
+
+def reader_thread(board: Board):
+    return board.depth
+
+
+def spawn_reader(board: Board):
+    threading.Thread(target=reader_thread, args=(board,)).start()

@@ -1,0 +1,42 @@
+"""loop-confinement positives: event-loop-owned state reached from
+call paths that can originate off the event loop."""
+import asyncio
+import threading
+
+from mcpx.utils.ownership import owned_by
+
+
+@owned_by("event_loop")
+class Pool:
+    def __init__(self):
+        self.routed = 0
+        self.state = "idle"
+
+    def bump(self):
+        self.routed += 1
+
+
+def thread_body(pool: Pool):
+    pool.routed += 1
+
+
+def start(pool: Pool):
+    threading.Thread(target=thread_body, args=(pool,)).start()
+
+
+async def offload(pool: Pool):
+    await asyncio.to_thread(thread_body, pool)
+
+
+def unspawned_entry(pool: Pool):
+    pool.state = "draining"
+    pool.bump()
+
+
+@owned_by("event_loop")
+def loop_mutator(pool: Pool):
+    pool.routed += 1
+
+
+def rogue_call(pool: Pool):
+    loop_mutator(pool)

@@ -1,0 +1,8 @@
+"""Agreeing stage pair: producer out-sharding matches the consumer's
+declared in-sharding, so the chain is reshard-free and silent."""
+from .stages import encode, rank
+
+
+def drive(tokens):
+    feats = encode(tokens)
+    return rank(feats)

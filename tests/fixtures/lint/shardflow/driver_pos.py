@@ -1,0 +1,9 @@
+"""Chains the mismatched stages: the boundary buffer is resharded
+(an all-to-all) on every call."""
+from .stages import decode, encode
+
+
+def drive(tokens):
+    feats = encode(tokens)
+    out = decode(feats)
+    return out

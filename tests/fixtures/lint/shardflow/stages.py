@@ -1,0 +1,19 @@
+"""Two jitted stages whose declared shardings disagree on the boundary
+buffer (the all-to-all-per-step shape), plus an agreeing consumer."""
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+mesh = Mesh((), ("data", "model"))
+
+
+def _encode(tokens):
+    return tokens
+
+
+def _decode(feats):
+    return feats
+
+
+encode = jax.jit(_encode, out_shardings=P("data"))
+decode = jax.jit(_decode, in_shardings=(P("model"),))
+rank = jax.jit(_decode, in_shardings=(P("data"),))

@@ -1,0 +1,42 @@
+"""sharding-contract negatives: declared axes (through module
+constants), agreeing producer/consumer pairs, dynamic specs, and a
+donation whose result is rebound rather than aliased."""
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+DATA_AXIS = "data"
+
+mesh = Mesh((), axis_names=(DATA_AXIS, "model"))
+
+
+def _enc(x):
+    return x
+
+
+def _dec(x):
+    return x
+
+
+def _axis():
+    return "data"
+
+
+enc = jax.jit(_enc, out_shardings=P(DATA_AXIS))
+dec = jax.jit(_dec, in_shardings=(P("data"),))
+dyn = jax.jit(_enc, in_shardings=(P(_axis()),))
+upd = jax.jit(_enc, donate_argnames=("x",), in_shardings=(P(DATA_AXIS),))
+
+
+def agreeing(x):
+    y = enc(x)
+    return dec(y)
+
+
+def constrained(x):
+    return jax.lax.with_sharding_constraint(x, P("model"))
+
+
+def rebinds(state):
+    keep = state
+    state = upd(state)
+    return state

@@ -1,0 +1,37 @@
+"""sharding-contract positives: an undeclared mesh axis, a
+producer/consumer sharding disagreement, and a live alias of a
+donated sharded buffer."""
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+mesh = Mesh((), ("data", "model"))
+
+
+def _enc(x):
+    return x
+
+
+def _dec(x):
+    return x
+
+
+def _upd(state):
+    return state
+
+
+enc = jax.jit(_enc, out_shardings=P("data"))
+dec = jax.jit(_dec, in_shardings=(P("model"),))
+bad = jax.jit(_enc, in_shardings=(P("tensor"),))
+upd = jax.jit(_upd, donate_argnames=("state",), in_shardings=(P("data"),))
+
+
+def chain(x):
+    y = enc(x)
+    z = dec(y)
+    return z
+
+
+def run(state):
+    keep = state
+    out = upd(state)
+    return keep, out

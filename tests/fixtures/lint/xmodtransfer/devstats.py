@@ -1,0 +1,5 @@
+"""Device-adjacent helper: forwards the raw device-backed mapping."""
+
+
+def device_stats(engine):
+    return engine.queue_stats()

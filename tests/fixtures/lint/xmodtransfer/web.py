@@ -1,0 +1,16 @@
+"""Async handler two call hops above a readback of a cross-module
+device-sourced value."""
+from .devstats import device_stats
+
+
+def summarize(engine):
+    st = device_stats(engine)
+    return float(st["depth"])
+
+
+def render(engine):
+    return summarize(engine)
+
+
+async def handler(request, engine):
+    return render(engine)

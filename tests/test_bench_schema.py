@@ -497,6 +497,35 @@ def test_report_verdicts_bands_and_scenario_exclusion():
     assert report["metrics"]["value"]["verdict"] == "improved"
 
 
+def test_absolute_noise_floor_for_near_zero_fractions():
+    """flight/ledger overhead and deadline-overrun share are paired
+    differences with a true value of ~0: when both the latest value and
+    the prior median sit inside the metric's absolute floor, the verdict
+    reads ok no matter how large the RELATIVE delta looks (r08..r10 kept
+    flagging 0.018 -> 0.054 as a 3x regression). A value that escapes
+    the floor is judged by the normal band."""
+    from mcpx.cli.bench_report import NOISE_FLOORS, render_text
+
+    prior = [
+        ("a", _mk_run(10.0, 100.0, flight_overhead_frac=-0.0183)),
+        ("b", _mk_run(10.1, 101.0, flight_overhead_frac=0.0026)),
+        ("c", _mk_run(9.9, 99.0, flight_overhead_frac=0.0173)),
+    ]
+    inside = ("z", _mk_run(10.0, 100.0, flight_overhead_frac=0.0544))
+    report = build_report([*prior, inside])
+    m = report["metrics"]["flight_overhead_frac"]
+    assert m["verdict"] == "ok"
+    assert m["floor_abs"] == NOISE_FLOORS["flight_overhead_frac"]
+    assert "flight_overhead_frac" not in report["regressions"]
+    assert "floor=±0.06 abs" in render_text(report)
+    # 12% measured overhead is NOT jitter: it escapes the floor and the
+    # near-zero median makes the relative delta blow past any band.
+    escaped = ("z", _mk_run(10.0, 100.0, flight_overhead_frac=0.12))
+    report = build_report([*prior, escaped])
+    assert report["metrics"]["flight_overhead_frac"]["verdict"] == "regressed"
+    assert "flight_overhead_frac" in report["regressions"]
+
+
 def test_report_missing_metric_is_flagged_when_it_vanishes():
     prior = [("a", _mk_run(10.0, 100.0, mfu=0.01)) for _ in range(3)]
     latest = ("z", _mk_run(10.0, 100.0))  # mfu dropped

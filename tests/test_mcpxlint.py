@@ -37,6 +37,9 @@ RULE_IDS = {
     "unbounded-cache-growth",
     "thread-ownership",
     "jit-contract",
+    "loop-confinement",
+    "blocking-transfer-on-loop",
+    "sharding-contract",
 }
 
 
@@ -269,6 +272,77 @@ def test_retry_rule_sees_bound_consults_through_helpers():
     ]
 
 
+def test_loop_confinement_positive():
+    # A method write reached through a thread-spawned body, the spawned
+    # body's own write, an unmarked sync entry nobody spawns, and a call
+    # into an @owned_by("event_loop") mutator from such an entry.
+    assert hits("loop_confinement_pos.py", "loop-confinement") == [16, 20, 32, 42]
+
+
+def test_loop_confinement_negative():
+    # Coroutine writers, helpers only async code calls, call_soon'd
+    # callbacks, marked mutators, ctor writes and cross-thread READS
+    # (the sanctioned GIL-atomic snapshot contract) all stay silent.
+    assert hits("loop_confinement_neg.py", "loop-confinement") == []
+
+
+def test_blocking_transfer_positive():
+    # float() over a queue_stats() field and np.asarray over a jitted
+    # result in the handler, comprehension-generator taint, and a sync
+    # helper one hop below an async request handler.
+    assert hits(
+        "blocking_transfer_pos.py", "blocking-transfer-on-loop"
+    ) == [16, 18, 19, 25]
+
+
+def test_blocking_transfer_negative():
+    # Offline sync readbacks, the to_thread'd nested-def fix shape
+    # (PR 7 /costs), host-native float() on the loop, and async code no
+    # request reaches all stay silent.
+    assert hits("blocking_transfer_neg.py", "blocking-transfer-on-loop") == []
+
+
+def test_blocking_transfer_two_hops_across_modules():
+    # handler -> render -> summarize, with the device source (a helper
+    # returning queue_stats() raw) defined in ANOTHER module: the
+    # readback is flagged at the float() two call hops below the root.
+    res = scan_paths(
+        [FIXTURES / "xmodtransfer"], root=REPO,
+        rules=["blocking-transfer-on-loop"],
+    )
+    assert [(f.path.rsplit("/", 1)[-1], f.line) for f in res.findings] == [
+        ("web.py", 8)
+    ]
+    assert "device_stats" in res.findings[0].message
+
+
+def test_sharding_contract_positive():
+    # An undeclared axis in a jit binding, a producer/consumer pair
+    # disagreeing on the boundary buffer, and a live alias of a donated
+    # sharded buffer.
+    assert hits("sharding_pos.py", "sharding-contract") == [24, 30, 37]
+
+
+def test_sharding_contract_negative():
+    # Axes resolved through module constants, agreeing pairs, dynamic
+    # (unparseable) specs and donations with no surviving alias are all
+    # silent — unknowns never flag.
+    assert hits("sharding_neg.py", "sharding-contract") == []
+
+
+def test_sharding_contract_two_executable_mismatch():
+    # The two-executable pair lives in one module, the chain in another:
+    # the registry is project-global, so the mismatch is flagged at the
+    # consumer dispatch; the agreeing driver stays silent.
+    res = scan_paths(
+        [FIXTURES / "shardflow"], root=REPO, rules=["sharding-contract"]
+    )
+    assert [(f.path.rsplit("/", 1)[-1], f.line) for f in res.findings] == [
+        ("driver_pos.py", 8)
+    ]
+    assert "all-to-all" in res.findings[0].message
+
+
 def test_engine_ownership_annotations_are_live():
     """The acceptance check behind the clean tree: the real engine files
     carry the declarations the pass runs on — worker entry, owned fields
@@ -311,6 +385,108 @@ def test_ownership_pass_guards_real_engine_fields(tmp_path):
         rules=["thread-ownership"],
     )
     assert any("rogue" in f.path and "_inflight" in f.message for f in res.findings)
+
+
+def test_cluster_loop_annotations_are_live():
+    """The loop-confinement acceptance check: the real cluster/telemetry
+    classes carry the event_loop declarations the pass runs on."""
+    from mcpx.analysis.core import FileContext, _relpath, iter_py_files
+    from mcpx.analysis.project import ProjectContext
+    from mcpx.analysis.rules.ownership_rules import LOOP_DOMAIN, _Ownership
+
+    files = iter_py_files(
+        [REPO / "mcpx" / "cluster", REPO / "mcpx" / "telemetry"]
+    )
+    ctxs = [FileContext(p, _relpath(p, REPO), p.read_text()) for p in files]
+    proj = ProjectContext(ctxs, REPO)
+    own = _Ownership(proj)
+    pool = "mcpx.cluster.pool.EnginePool"
+    assert proj.index.classes[pool].owner == LOOP_DOMAIN
+    assert (pool, "_closed") in own.fields
+    assert own.fields[(pool, "_closed")][0] == LOOP_DOMAIN
+    rep = "mcpx.cluster.replica.ReplicaHandle"
+    assert proj.index.classes[rep].owner == LOOP_DOMAIN
+    assert proj.index.functions[f"{rep}.note_result"].owner == LOOP_DOMAIN
+    rp = "mcpx.cluster.routing.RoutingPipeline"
+    assert proj.index.classes[rp].owner == LOOP_DOMAIN
+    assert proj.index.functions[f"{rp}.route"].owner == LOOP_DOMAIN
+    led = "mcpx.telemetry.ledger.UsageLedger"
+    assert proj.index.classes[led].owner == LOOP_DOMAIN
+    assert proj.index.functions[f"{led}.observe"].owner == LOOP_DOMAIN
+    slo = "mcpx.telemetry.slo.SLOTracker"
+    assert proj.index.classes[slo].owner == LOOP_DOMAIN
+    fr = "mcpx.telemetry.flight.FlightRecorder"
+    assert proj.index.classes[fr].owner == LOOP_DOMAIN
+
+
+def test_loop_pass_guards_real_cluster_state(tmp_path):
+    # A foreign sync entry mutating loop-owned pool state IS flagged —
+    # the annotated tree is clean because nothing violates, not because
+    # the pass is inert. Removing EnginePool's annotation breaks this.
+    rogue = tmp_path / "rogue.py"
+    rogue.write_text(
+        "from mcpx.cluster.pool import EnginePool\n\n\n"
+        "def rogue(pool: EnginePool):\n"
+        "    pool.resteers += 1\n"
+    )
+    res = scan_paths(
+        [REPO / "mcpx" / "cluster", REPO / "mcpx" / "utils", rogue],
+        root=REPO,
+        rules=["loop-confinement"],
+    )
+    assert any(
+        "rogue" in f.path and "resteers" in f.message for f in res.findings
+    )
+    # ...and the cluster package alone stays clean in the same scan.
+    assert not [f for f in res.findings if "rogue" not in f.path]
+
+
+def test_every_mutable_cluster_class_declares_ownership():
+    """The opt-out gate: any mcpx/cluster/ class whose methods mutate
+    instance state outside the ctor must declare an ownership domain
+    (class decorator, method mark, or per-field owner comment) — new
+    cluster code can't silently skip the concurrency contract."""
+    import ast as _ast
+
+    from mcpx.analysis.core import FileContext, _relpath, iter_py_files
+    from mcpx.analysis.project import ProjectContext
+    from mcpx.analysis.rules.ownership_rules import _Ownership
+
+    files = iter_py_files([REPO / "mcpx" / "cluster"])
+    ctxs = [FileContext(p, _relpath(p, REPO), p.read_text()) for p in files]
+    proj = ProjectContext(ctxs, REPO)
+    own = _Ownership(proj)
+    field_marked = {cq for (cq, _attr) in own.fields}
+    ctors = {"__init__", "__post_init__", "__new__"}
+    offenders = []
+    for cq, ci in proj.index.classes.items():
+        if not cq.startswith("mcpx.cluster.") or ci.owner:
+            continue
+        mutating = []
+        for fq, fi in proj.index.functions.items():
+            if not fq.startswith(cq + ".") or fi.name in ctors or fi.owner:
+                continue
+            for node in _ast.walk(fi.node):
+                targets = []
+                if isinstance(node, _ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, (_ast.AugAssign, _ast.AnnAssign)):
+                    targets = [node.target]
+                for t in targets:
+                    while isinstance(t, _ast.Subscript):
+                        t = t.value
+                    if (
+                        isinstance(t, _ast.Attribute)
+                        and isinstance(t.value, _ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        mutating.append(f"{fi.name}:{node.lineno}")
+        if mutating and cq not in field_marked:
+            offenders.append((cq, mutating))
+    assert offenders == [], (
+        "cluster classes with post-ctor mutable state but no ownership "
+        f"annotation: {offenders}"
+    )
 
 
 def test_committed_baseline_is_empty():
@@ -723,6 +899,150 @@ def test_cli_changed_leaves_other_files_baseline_alone(tmp_path):
     after = load_baseline(base)
     assert [e for e in after if e["path"] == "a.py"] == before
     assert {e["path"] for e in after} == {"a.py", "b.py"}
+
+
+# ------------------------------------------------------------------- --fix
+_FIXABLE = (
+    "import time\n"
+    "\n"
+    "\n"
+    "\n"
+    "\n"
+    "async def f():\n"
+    "    time.sleep(1)  # mcpx: ignore[async-blocking,async-blocking] - dupe\n"
+    "    x = 1  # mcpx: ignore[blank-lines] - never fires here\n"
+    "    # mcpx: ignore[asnyc-blocking] - typo'd id, comment-only line\n"
+    "    return x\n"
+)
+
+_FIXED = (
+    "import time\n"
+    "\n"
+    "\n"
+    "async def f():\n"
+    "    time.sleep(1)  # mcpx: ignore[async-blocking] - dupe\n"
+    "    x = 1\n"
+    "    return x\n"
+)
+
+
+def test_fix_rewrites_mechanical_findings(tmp_path):
+    # Duplicate ids collapse, a dead suppression vanishes with its
+    # justification, a comment-only suppression line is deleted, and the
+    # blank run collapses to two — then a re-scan is clean and a second
+    # --fix pass is a no-op (idempotent).
+    p = tmp_path / "t.py"
+    p.write_text(_FIXABLE)
+    out = io.StringIO()
+    code = run_lint(
+        [str(p)], baseline=str(tmp_path / "none.json"), root=str(tmp_path),
+        fix=True, out=out,
+    )
+    assert code == 0
+    assert p.read_text() == _FIXED
+    assert "rewrote 1 file(s)" in out.getvalue()
+    res = scan_paths([p], root=tmp_path)
+    assert [f.rule for f in res.findings] == []
+    assert res.suppressed == 1  # the real async-blocking suppression stays
+    out2 = io.StringIO()
+    assert run_lint(
+        [str(p)], baseline=str(tmp_path / "none.json"), root=str(tmp_path),
+        fix=True, out=out2,
+    ) == 0
+    assert p.read_text() == _FIXED
+    assert "rewrote 0 file(s)" in out2.getvalue()
+
+
+def test_fix_dry_run_prints_diff_and_writes_nothing(tmp_path):
+    p = tmp_path / "t.py"
+    p.write_text(_FIXABLE)
+    out = io.StringIO()
+    code = run_lint(
+        [str(p)], baseline=str(tmp_path / "none.json"), root=str(tmp_path),
+        fix=True, fix_dry_run=True, out=out,
+    )
+    assert code == 0
+    assert p.read_text() == _FIXABLE  # untouched
+    diff = out.getvalue()
+    assert "--- a/t.py" in diff and "+++ b/t.py" in diff
+    assert "-    x = 1  # mcpx: ignore[blank-lines] - never fires here" in diff
+    assert "+    x = 1" in diff
+    assert "would rewrite 1 file(s)" in diff
+
+
+def test_fix_respects_rule_selection(tmp_path):
+    # Known suppression ids are judged only against rules that ran: an
+    # async-blocking-only --fix must leave the (dead) blank-lines
+    # suppression alone, while a typo'd id is removed regardless.
+    p = tmp_path / "t.py"
+    p.write_text(_FIXABLE)
+    assert run_lint(
+        [str(p)], baseline=str(tmp_path / "none.json"), root=str(tmp_path),
+        rules=["async-blocking"], fix=True, out=io.StringIO(),
+    ) == 0
+    text = p.read_text()
+    assert "ignore[blank-lines] - never fires here" in text
+    assert "asnyc-blocking" not in text
+
+
+def test_fix_cli_flags_wired():
+    from mcpx.cli.main import main
+    import contextlib
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        p = pathlib.Path(d) / "t.py"
+        p.write_text(_FIXABLE)
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            code = main([
+                "lint", str(p), "--fix", "--dry-run",
+                "--baseline", str(pathlib.Path(d) / "none.json"),
+            ])
+        assert code == 0
+        assert p.read_text() == _FIXABLE
+        assert "would rewrite 1 file(s)" in buf.getvalue()
+
+
+def test_cli_changed_sarif_smoke(tmp_path, monkeypatch):
+    # The CI shape: `mcpx lint --changed --format sarif` end to end
+    # through the real subcommand over a dirty worktree.
+    import contextlib
+    import subprocess
+
+    def git(*args):
+        subprocess.run(
+            ["git", "-c", "user.email=t@t", "-c", "user.name=t", *args],
+            cwd=tmp_path, check=True, capture_output=True,
+        )
+
+    git("init", "-q")
+    (tmp_path / "a.py").write_text("def ok():\n    return 1\n")
+    git("add", ".")
+    git("commit", "-qm", "seed")
+    (tmp_path / "a.py").write_text(
+        "import time\n\n\nasync def f():\n    time.sleep(1)\n"
+    )
+    from mcpx.cli.main import main
+
+    monkeypatch.chdir(tmp_path)
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        code = main([
+            "lint", str(tmp_path), "--changed", "--format", "sarif",
+            "--baseline", str(tmp_path / "none.json"),
+        ])
+    assert code == 1
+    doc = json.loads(buf.getvalue())
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "mcpxlint"
+    results = run["results"]
+    assert {r["ruleId"] for r in results} == {"async-blocking"}
+    assert all(
+        r["locations"][0]["physicalLocation"]["artifactLocation"]["uri"]
+        == "a.py"
+        for r in results
+    )
 
 
 # ----------------------------------------------------------- tier-1 gate
