@@ -2987,6 +2987,127 @@ async def _cluster_phase(cp) -> "dict | None":
     }
 
 
+async def _provenance_phase(cp) -> "dict | None":
+    """Decision-provenance overhead scenario (ISSUE 19 acceptance): the
+    SAME direct-plan workload served with the provenance recorder OFF
+    (``recorder=None`` — the default pass-through) and ON (a live
+    ProvenanceRecorder whose trail the workload begins/ends per request,
+    exactly what the server middleware does), in interleaved best-of
+    rounds like the flight/ledger phases. BOTH arms open a root span per
+    request at sample rate 1.0, so ``provenance_overhead_frac`` isolates
+    the recorder's own cost — trail contextvar, decision child spans,
+    counters — not tracing's, which has its own phase gate (<3%
+    acceptance). Also reports ``explanation_coverage``: the fraction of
+    ON-arm traces whose /explain output validates AND names the plan
+    decision this workload is guaranteed to make. Skip with
+    MCPX_BENCH_PROVENANCE=0."""
+    if os.environ.get("MCPX_BENCH_PROVENANCE", "1") == "0":
+        return None
+    engine = getattr(cp.planner, "engine", None)
+    if engine is None or engine.state != "ready":
+        return None
+    import random as _random
+
+    from mcpx.telemetry import provenance as prov_mod
+    from mcpx.telemetry import tracing
+    from mcpx.telemetry.provenance import (
+        ProvenanceRecorder,
+        build_explanation,
+        validate_explanation,
+    )
+    from mcpx.telemetry.tracing import Tracer
+    from mcpx.utils.synth import intent_for
+
+    records = await cp.registry.list_services()
+    rng = _random.Random(47)
+    n = int(os.environ.get("MCPX_BENCH_PROVENANCE_REQUESTS", "96"))
+    rounds = 3
+    concurrency = min(engine.config.engine.max_batch_size, 16)
+    base_pool = [f"{intent_for(records, rng)} [prv{i}]" for i in range(8)]
+    tracer = Tracer(enabled=True, sample_rate=1.0, ring_size=max(1024, n))
+
+    async def _idle() -> None:
+        while engine._slab.n_active or engine._queue.qsize():
+            await asyncio.sleep(0.05)
+        await asyncio.sleep(0.1)
+
+    tag = {"n": 0}
+
+    async def one_round(recorder) -> "tuple[float, list]":
+        # Fresh cache-busted intents per round: every round pays the same
+        # plan/prefill/decode work whatever ran before it.
+        tag["n"] += 1
+        intents = [
+            f"{base_pool[i % len(base_pool)]} r{tag['n']}-{i}" for i in range(n)
+        ]
+        await _idle()
+        sem = asyncio.Semaphore(concurrency)
+        recs: list = []
+
+        async def one(intent: str) -> None:
+            async with sem:
+                root = tracer.start_request("/plan", method="POST")
+                token = prov_mod.begin(recorder)
+                err = False
+                try:
+                    with tracing.activate(root):
+                        await cp.plan(intent, use_cache=False)
+                except Exception:  # noqa: BLE001 - a failed plan still finishes its trace
+                    err = True
+                finally:
+                    prov_mod.end(token)
+                    tracer.finish(root, error=err)
+                recs.append(root.record)
+
+        t0 = time.monotonic()
+        await asyncio.gather(*(one(i) for i in intents))
+        await _idle()
+        return n / max(1e-9, time.monotonic() - t0), recs
+
+    off_rates: list[float] = []
+    on_rates: list[float] = []
+    on_records: list = []
+    recorder = ProvenanceRecorder(
+        cp.config.telemetry.provenance, metrics=cp.metrics
+    )
+    for _ in range(rounds):
+        # OFF: the default pass-through — no trail ever begins.
+        rate, _ = await one_round(None)
+        off_rates.append(rate)
+        # ON: per-request trail + decision spans + counters.
+        rate, recs = await one_round(recorder)
+        on_rates.append(rate)
+        on_records = recs
+    explanations = [build_explanation(r) for r in on_records]
+    covered = [
+        e for e in explanations
+        if not validate_explanation(e)
+        and any(d["layer"] == "plan" for d in e["decisions"])
+    ]
+    decisions_per_request = (
+        sum(len(e["decisions"]) for e in explanations) / max(1, len(explanations))
+    )
+    best_off, best_on = max(off_rates), max(on_rates)
+    return {
+        "requests": n,
+        "rounds": rounds,
+        "plans_per_sec_off": round(best_off, 2),
+        "plans_per_sec_on": round(best_on, 2),
+        # The acceptance number: fractional headline cost of recording
+        # every decision (negative = measurement noise).
+        "provenance_overhead_frac": round(
+            1.0 - best_on / max(1e-9, best_off), 4
+        ),
+        # Fraction of ON-arm requests whose /explain output is
+        # schema-valid and names the plan-origin decision.
+        "explanation_coverage": round(
+            len(covered) / max(1, len(explanations)), 4
+        ),
+        "decisions_per_request": round(decisions_per_request, 2),
+        "records_emitted": recorder.records_emitted,
+    }
+
+
 async def _run(model_size: str, n_requests: int, concurrency: int, n_services: int) -> dict:
     from aiohttp import ClientSession, TCPConnector
     from aiohttp.test_utils import TestServer
@@ -3239,6 +3360,12 @@ async def _run(model_size: str, n_requests: int, concurrency: int, n_services: i
         # sits idle throughout.
         cluster = await _cluster_phase(cp)
 
+        # ---- Phase 14: decision provenance (ISSUE 19) — same live-attach
+        # discipline as the flight/ledger phases (a recorder + tracer the
+        # workload begins/ends per request; nothing mutated on cp, so no
+        # restore needed); runs after every headline scrape.
+        provenance = await _provenance_phase(cp)
+
         # ---- Phase 5: latency attribution (ISSUE 4) — a traced open-loop
         # sample at the phase-2 rate; runs after every headline scrape
         # because attaching the tracer is the one thing this phase does
@@ -3411,6 +3538,10 @@ async def _run(model_size: str, n_requests: int, concurrency: int, n_services: i
         # with one replica killed mid-phase, routed-vs-round-robin prefix
         # token hit rate, and the warm-rejoin KV-snapshot prefill ratio.
         "cluster": cluster,
+        # Decision-provenance scenario (None when skipped): recorder
+        # overhead vs the pass-through, /explain schema coverage, and
+        # decisions recorded per request.
+        "provenance": provenance,
         # Per-phase latency attribution from sampled request traces (None
         # when skipped): p50/p99 of scheduler-queue vs engine admit-wait vs
         # prefill vs decode vs tool fan-out, plus each phase's share of the
@@ -3979,6 +4110,18 @@ def _output_json(stats: dict, quality_trained, model: str) -> dict:
                 "cluster_warm_rejoin_prefill_ratio": (
                     stats["cluster"]["cluster_warm_rejoin_prefill_ratio"]
                     if stats.get("cluster") else None
+                ),
+                "provenance": stats.get("provenance"),
+                # Acceptance keys promoted to the top level (ISSUE 19):
+                # the decision recorder's fractional headline cost and
+                # the /explain schema-coverage fraction.
+                "provenance_overhead_frac": (
+                    stats["provenance"]["provenance_overhead_frac"]
+                    if stats.get("provenance") else None
+                ),
+                "explanation_coverage": (
+                    stats["provenance"]["explanation_coverage"]
+                    if stats.get("provenance") else None
                 ),
                 "ledger": stats.get("ledger"),
                 # Acceptance keys promoted to the top level (ISSUE 14):

@@ -64,6 +64,8 @@ TRACKED_METRICS: tuple[tuple[str, str, Optional[str]], ...] = (
     ("replan_warm_sat_p50_ms", "lower", None),
     ("flight_overhead_frac", "lower", None),
     ("ledger_overhead_frac", "lower", None),
+    ("provenance_overhead_frac", "lower", None),
+    ("explanation_coverage", "higher", None),
     ("decode_dispatches_per_token", "lower", None),
     ("fused_decode_speedup", "higher", None),
     ("attribution.wall_attributed_frac", "higher", None),
@@ -101,6 +103,7 @@ MIN_BAND = 0.05
 NOISE_FLOORS: dict[str, float] = {
     "flight_overhead_frac": 0.06,
     "ledger_overhead_frac": 0.10,
+    "provenance_overhead_frac": 0.06,
     "deadline_overrun_share": 0.02,
 }
 

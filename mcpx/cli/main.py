@@ -162,6 +162,41 @@ def cmd_debug(args: argparse.Namespace) -> int:
         return 1
 
 
+def cmd_explain(args: argparse.Namespace) -> int:
+    """Decision-provenance explanation for one trace from a running server
+    (mcpx/telemetry/provenance.py, docs/observability.md "Decision
+    provenance & /explain"): fetches GET /explain/{trace_id}, validates the
+    schema, prints the human-readable narrative followed by the structured
+    JSON. ``--id`` optional: defaults to the newest retained trace, so
+    ``mcpx explain`` right after a failed request explains THAT request."""
+    from mcpx.telemetry.provenance import validate_explanation
+
+    base = args.url.rstrip("/")
+    try:
+        trace_id = args.trace_id
+        if not trace_id:
+            traces = _http_json(f"{base}/traces").get("traces", [])
+            if not traces:
+                print(json.dumps({"error": "no traces retained on the server"}))
+                return 1
+            trace_id = traces[0]["trace_id"]
+        out = _http_json(f"{base}/explain/{trace_id}")
+    except RuntimeError as e:
+        print(json.dumps({"error": str(e)}))
+        return 1
+    problems = validate_explanation(out)
+    for line in out.get("narrative", []):
+        print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=2)
+    print(json.dumps(out, indent=2))
+    if problems:
+        print(json.dumps({"error": "invalid explanation", "problems": problems}))
+        return 1
+    return 0
+
+
 def cmd_usage(args: argparse.Namespace) -> int:
     """Per-tenant usage ledger from a running server (mcpx/telemetry/
     ledger.py, docs/observability.md "Cost ledger & SLO budgets"):
@@ -426,6 +461,23 @@ def main(argv: list[str] | None = None) -> int:
         help="output path for bundle (default: bundle_<id>.json)",
     )
     p_debug.set_defaults(func=cmd_debug)
+
+    p_explain = sub.add_parser(
+        "explain",
+        help="decision-provenance narrative for one trace from a running server",
+    )
+    p_explain.add_argument(
+        "trace_id", nargs="?", default="",
+        help="trace id to explain (default: the newest retained trace)",
+    )
+    p_explain.add_argument(
+        "--url", default="http://127.0.0.1:8000",
+        help="server base URL (default: %(default)s)",
+    )
+    p_explain.add_argument(
+        "--out", default="", help="also write the explanation JSON to this path"
+    )
+    p_explain.set_defaults(func=cmd_explain)
 
     p_usage = sub.add_parser(
         "usage", help="per-tenant usage ledger from a running server"

@@ -35,6 +35,7 @@ is the engine's own published contract.
 from __future__ import annotations
 
 import asyncio
+import collections
 import copy
 import logging
 import os
@@ -52,9 +53,83 @@ from mcpx.cluster.routing import (
     build_pipeline,
     rendezvous_choice,
 )
+from mcpx.telemetry import provenance, tracing
 from mcpx.utils.ownership import owned_by
 
 log = logging.getLogger("mcpx.cluster")
+
+
+@owned_by("event_loop")
+class RoutingJournal:
+    """Bounded routing/failover event journal (ISSUE 19): every pool
+    lifecycle decision — routed / affinity_hit / degraded_route / resteer
+    / kill / drain / rejoin — lands here with a timestamp and sequence
+    number, so a cluster anomaly bundle can replay WHICH decisions put
+    load where. Events are bounded (oldest evicted); the per-kind counts
+    are cumulative and feed the flight recorder's window-delta signals
+    (affinity hit rate, resteer rate, degraded-route share). Loop-confined
+    like the pool that writes it."""
+
+    def __init__(self, maxlen: int) -> None:
+        self.events: "collections.deque[dict]" = collections.deque(  # mcpx: owner[event_loop]
+            maxlen=max(1, int(maxlen))
+        )
+        self.counts: dict[str, int] = {}  # mcpx: owner[event_loop]
+        self.seq = 0  # mcpx: owner[event_loop]
+
+    def bump(self, kind: str) -> None:
+        """Count a decision outcome without journaling an event (the
+        high-rate per-route outcomes that would otherwise drown the
+        lifecycle tail)."""
+        self.counts[kind] = self.counts.get(kind, 0) + 1
+
+    def note(self, kind: str, replica: int, **extra: Any) -> None:
+        self.bump(kind)
+        self.seq += 1
+        self.events.append(
+            {
+                "seq": self.seq,
+                "ts": round(time.time(), 3),
+                "kind": kind,
+                "replica": replica,
+                **extra,
+            }
+        )
+
+    def tail(self, n: Optional[int] = None) -> list[dict]:
+        evs = list(self.events)
+        return evs if n is None else evs[-n:]
+
+
+@owned_by("event_loop")
+class ReplicaSignalRing:
+    """Per-replica signal ring behind the pool (ISSUE 19): a bounded
+    history of one replica slot's scoreboard snapshots (state, queue
+    depth, ETA, error rate, in-flight), appended by the scoreboard
+    refresh loop — the per-replica timeline an anomaly bundle needs to
+    show load concentrating before a trip."""
+
+    def __init__(self, index: int, maxlen: int) -> None:
+        self.index = index
+        self.ring: "collections.deque[dict]" = collections.deque(  # mcpx: owner[event_loop]
+            maxlen=max(1, int(maxlen))
+        )
+
+    def append(self, r: ReplicaHandle) -> None:
+        st = r.stats
+        self.ring.append(
+            {
+                "ts": round(time.time(), 3),
+                "state": r.state,
+                "depth": int(st.get("depth", 0)) + r.inflight,
+                "eta_s": round(float(st.get("eta_s", 0.0)), 4),
+                "error_rate": round(r.error_rate(), 4),
+                "inflight": r.inflight,
+            }
+        )
+
+    def tail(self, n: int = 32) -> list[dict]:
+        return list(self.ring)[-n:]
 
 
 class ClusterPin:
@@ -92,6 +167,12 @@ class EnginePool:
         self._chaos_task: Optional[asyncio.Task] = None
         self._closed = False  # mcpx: owner[event_loop]
         self.resteers = 0  # mcpx: owner[event_loop]
+        pv = config.telemetry.provenance
+        self.journal = RoutingJournal(pv.journal_size)
+        self._rings: dict[int, ReplicaSignalRing] = {
+            i: ReplicaSignalRing(i, pv.replica_ring)
+            for i in range(config.cluster.replicas)
+        }
         if engine_factory is None:
             from mcpx.engine.engine import InferenceEngine  # deferred: pulls in JAX
 
@@ -248,6 +329,18 @@ class EnginePool:
                     self.resteers += 1
                     r.resteered_away += 1
                     self._inc("cluster_resteers")
+                    self.journal.note(
+                        "resteer", r.index,
+                        trace_id=tracing.current_trace_id() or "",
+                        error=f"{type(e).__name__}: {e}",
+                    )
+                    if provenance.active():
+                        provenance.emit(
+                            "route",
+                            f"resteer away from replica {r.index}",
+                            signals={"replica_state": r.state},
+                            error=f"{type(e).__name__}: {e}",
+                        )
                     last_err = e
                     continue
                 raise
@@ -359,10 +452,29 @@ class EnginePool:
     def _note_route(self, r: ReplicaHandle, req: RouteRequest) -> None:
         r.routed += 1
         self._inc("cluster_routed", replica=str(r.index))
+        trace_id = tracing.current_trace_id() or ""
+        self.journal.note("routed", r.index, trace_id=trace_id)
         aff = self._pipeline.affinity
         if aff is not None and aff.last_preferred == r.index:
             r.affinity_hits += 1
             self._inc("cluster_affinity_hits", replica=str(r.index))
+            self.journal.bump("affinity_hit")
+        elif aff is not None and aff.last_preferred is not None:
+            # Affinity preferred a (KV-warm) replica but the summed score
+            # sent the request elsewhere — a degraded placement. A surging
+            # share is the flight recorder's degraded_route_share signal.
+            self.journal.bump("degraded_route")
+        # Routing attribution counter (+ exemplar trace id, like the PR 4
+        # latency histograms): which policy decided this placement.
+        decision = self._pipeline.last_decision
+        pw = decision.get("policy_winner")
+        if pw:
+            m = self._metrics
+            fam = getattr(m, "route_decisions", None) if m is not None else None
+            if fam is not None:
+                fam.labels(policy_winner=pw).inc(
+                    exemplar={"trace_id": trace_id} if trace_id else None
+                )
 
     def _inc(self, family: str, **labels) -> None:
         m = self._metrics
@@ -379,6 +491,7 @@ class EnginePool:
         in-flight rows on this replica fail now."""
         r = self._replicas[index]
         r.state = "dead"
+        self.journal.note("kill", index, generation=r.generation)
         if getattr(r.engine, "state", None) in ("ready", "warming"):
             await r.engine.aclose()
 
@@ -388,6 +501,7 @@ class EnginePool:
         r = self._replicas[index]
         if r.state == "ready":
             r.state = "draining"
+        self.journal.note("drain", index, inflight=r.inflight)
         deadline = time.monotonic() + self.config.cluster.drain_timeout_s
         while r.inflight > 0 and time.monotonic() < deadline:
             await asyncio.sleep(0.02)
@@ -415,6 +529,7 @@ class EnginePool:
             raise
         r.state = "ready"
         r.stats = {}
+        self.journal.note("rejoin", index, generation=r.generation)
         self.refresh_scoreboard()
 
     async def _run_chaos(self) -> None:
@@ -445,6 +560,7 @@ class EnginePool:
                     r.stats_at = time.monotonic()
                 except Exception:  # noqa: BLE001 - a dying replica's stats
                     log.debug("scoreboard refresh failed for replica %d", r.index)
+            self._rings[r.index].append(r)
         self.update_gauges()
 
     async def run_scoreboard(self) -> None:
@@ -484,6 +600,52 @@ class EnginePool:
             "resteers": self.resteers,
             "policies": [p.name for p in self._pipeline.policies],
             "last_decision": self._pipeline.last_decision,
+            # The ISSUE 19 rings: recent routing decisions (each with the
+            # requesting trace_id) + the failover journal tail.
+            "decisions": self._pipeline.recent_decisions(),
+            "journal": self.journal.tail(64),
+            "journal_counts": dict(self.journal.counts),
+        }
+
+    def journal_counts(self) -> dict[str, int]:
+        """Cumulative decision-outcome counts (routed / affinity_hit /
+        degraded_route / resteer / ...) — the flight recorder deltas
+        consecutive samples into its window-delta cluster signals."""
+        return dict(self.journal.counts)
+
+    def attribution(self) -> dict:
+        """Per-replica decision attribution for anomaly bundles: which
+        decisions put load where. Each replica row carries its lifetime
+        route/affinity/resteer counts, how many of the RECENT routing
+        decisions (the pipeline ring) picked it — with the trace ids to
+        chase — which policy won those placements, and its signal-ring
+        tail; the journal tail replays the failover timeline."""
+        recent = self._pipeline.recent_decisions()
+        per: dict[str, dict] = {}
+        for r in self._replicas:
+            mine = [d for d in recent if d.get("replica") == r.index]
+            winners: dict[str, int] = {}
+            for d in mine:
+                pw = d.get("policy_winner") or ""
+                if pw:
+                    winners[pw] = winners.get(pw, 0) + 1
+            per[str(r.index)] = {
+                "state": r.state,
+                "routed": r.routed,
+                "affinity_hits": r.affinity_hits,
+                "resteered_away": r.resteered_away,
+                "inflight": r.inflight,
+                "recent_decisions": len(mine),
+                "policy_winners": winners,
+                "recent_trace_ids": [
+                    d["trace_id"] for d in mine if d.get("trace_id")
+                ][-8:],
+                "signals": self._rings[r.index].tail(16),
+            }
+        return {
+            "replicas": per,
+            "journal": self.journal.tail(64),
+            "journal_counts": dict(self.journal.counts),
         }
 
     def update_gauges(self) -> None:

@@ -29,11 +29,14 @@ prefix hit rate) and as a null hypothesis in tests.
 
 from __future__ import annotations
 
+import collections
 import hashlib
+import time
 from dataclasses import dataclass, field
 from typing import Any, Optional, Sequence
 
 from mcpx.cluster.replica import ReplicaHandle
+from mcpx.telemetry import provenance, tracing
 from mcpx.utils.ownership import owned_by
 
 
@@ -243,10 +246,21 @@ class RoutingPipeline:
     echo) without locks. The method-level marks assert the loop domain at
     the unresolved ``p.score(...)`` dispatch boundary."""
 
-    def __init__(self, policies: Sequence[Any]) -> None:
+    def __init__(self, policies: Sequence[Any], *, ring_size: int = 128) -> None:
         self.policies = list(policies)
-        # Last decision, for GET /cluster ("why did this land there").
-        self.last_decision: dict[str, Any] = {}  # mcpx: owner[event_loop]
+        # Recent decisions, newest last, for GET /cluster ("why did this
+        # land there") — was a single last-writer-wins dict before ISSUE
+        # 19, so only the newest request in the whole pool was ever
+        # explainable. Each entry carries the requesting trace_id so
+        # routing and tracing cross-reference.
+        self.decisions: "collections.deque[dict]" = collections.deque(  # mcpx: owner[event_loop]
+            maxlen=max(1, int(ring_size))
+        )
+
+    @property
+    def last_decision(self) -> dict[str, Any]:
+        """Newest decision (back-compat for the pre-ring readers)."""
+        return self.decisions[-1] if self.decisions else {}
 
     @owned_by("event_loop")
     def route(
@@ -264,15 +278,46 @@ class RoutingPipeline:
         winner = min(
             candidates, key=lambda r: (-scores[r.index], r.index)
         )
-        self.last_decision = {
+        # Attribution: the policy contributing most to the winner's score
+        # (ties break by pipeline order — the baseline wins a dead heat).
+        policy_winner = max(
+            contributions,
+            key=lambda name: contributions[name].get(winner.index, 0.0),
+        ) if contributions else ""
+        decision = {
+            "ts": round(time.time(), 3),
             "replica": winner.index,
+            "policy_winner": policy_winner,
+            "trace_id": tracing.current_trace_id() or "",
             "scores": {str(k): round(v, 6) for k, v in scores.items()},
             "policies": {
                 name: {str(k): round(v, 6) for k, v in c.items()}
                 for name, c in contributions.items()
             },
         }
+        self.decisions.append(decision)
+        if provenance.active():
+            provenance.emit(
+                "route",
+                f"routed to replica {winner.index}",
+                alternatives=[
+                    f"replica {r.index}" for r in candidates
+                    if r.index != winner.index
+                ],
+                contributions={
+                    name: round(c.get(winner.index, 0.0), 6)
+                    for name, c in contributions.items()
+                },
+                signals={
+                    str(r.index): round(scores[r.index], 6) for r in candidates
+                },
+                policy_winner=policy_winner,
+            )
         return winner
+
+    def recent_decisions(self) -> list[dict]:
+        """The ring, oldest first (GET /cluster)."""
+        return list(self.decisions)
 
     @property
     def affinity(self) -> Optional[PrefixAffinityPolicy]:
@@ -298,4 +343,6 @@ def build_pipeline(config, *, slo=None, ledger=None) -> RoutingPipeline:
         )
     if cl.burn_aware:
         policies.append(CostBurnPolicy(slo=slo, ledger=ledger))
-    return RoutingPipeline(policies)
+    return RoutingPipeline(
+        policies, ring_size=config.telemetry.provenance.route_ring
+    )

@@ -403,6 +403,41 @@ class LedgerConfig:
 
 
 @dataclass
+class ProvenanceConfig:
+    """Decision-provenance spine (mcpx/telemetry/provenance.py,
+    docs/observability.md "Decision provenance & /explain"): a typed
+    ``DecisionRecord`` — layer, choice, alternatives considered,
+    per-factor score contributions, triggering signal values — emitted at
+    every consequential choice point (scheduler admission + ladder tier,
+    plan origin, cluster routing winner, breaker/hedge/budget/replan
+    resilience events, prefix-cache & tier events) and attached to the
+    span tree under the PR 4 tail-sampling rules, rendered at
+    ``GET /explain/{trace_id}`` + ``mcpx explain`` as structured JSON and
+    a human-readable narrative. Off by default: with ``enabled=false`` no
+    recorder is activated anywhere on the serving path — token outputs,
+    queue_stats and span trees are byte-identical (parity-tested). The
+    cluster routing-decision ring and failover journal are always-on
+    accounting (they replace the old single ``last_decision`` dict); only
+    the per-request decision spans + mcpx_provenance_records_total are
+    gated here."""
+
+    enabled: bool = False
+    # Decision records attached per trace before further emits are
+    # dropped (counted in the root span's provenance_dropped attr) — a
+    # replan storm must not balloon a retained trace without bound.
+    max_records_per_trace: int = 64
+    # Recent routing decisions retained in the cluster ring served by
+    # GET /cluster (each entry carries the requesting trace_id).
+    route_ring: int = 128
+    # Routing/failover lifecycle events (routed / affinity_hit / resteer /
+    # kill / rejoin / drain) retained in the pool's bounded journal.
+    journal_size: int = 512
+    # Per-replica signal-ring length (scoreboard snapshots behind the
+    # pool, one ring per replica, fed by the scoreboard refresh task).
+    replica_ring: int = 128
+
+
+@dataclass
 class SLOConfig:
     """SLO error-budget engine (mcpx/telemetry/slo.py): declarative
     objectives over the serving path, multi-window multi-burn-rate
@@ -458,6 +493,9 @@ class TelemetryConfig:
     # Per-request cost ledger + per-tenant usage attribution
     # (mcpx/telemetry/ledger.py; see LedgerConfig).
     ledger: LedgerConfig = field(default_factory=LedgerConfig)
+    # Decision-provenance spine: per-request "why" records + GET /explain
+    # (mcpx/telemetry/provenance.py; see ProvenanceConfig).
+    provenance: ProvenanceConfig = field(default_factory=ProvenanceConfig)
     # Replan when a node's observed error-rate breaches this threshold.
     replan_error_rate: float = 0.5
     # or when latency exceeds this multiple of the registry's cost profile.
@@ -895,6 +933,17 @@ class MCPXConfig:
             problems.append("telemetry.ledger.max_tenants must be >= 1")
         if lg.recent < 0:
             problems.append("telemetry.ledger.recent must be >= 0")
+        pv = self.telemetry.provenance
+        if pv.max_records_per_trace < 1:
+            problems.append(
+                "telemetry.provenance.max_records_per_trace must be >= 1"
+            )
+        if pv.route_ring < 1:
+            problems.append("telemetry.provenance.route_ring must be >= 1")
+        if pv.journal_size < 1:
+            problems.append("telemetry.provenance.journal_size must be >= 1")
+        if pv.replica_ring < 1:
+            problems.append("telemetry.provenance.replica_ring must be >= 1")
         so = self.slo
         if not isinstance(so.windows_s, list) or len(so.windows_s) < 2:
             problems.append("slo.windows_s must list >= 2 window lengths")

@@ -47,7 +47,7 @@ from mcpx.core.dag import DagNode, Plan
 from mcpx.core.trace import ExecutionTrace, NodeAttempt
 from mcpx.orchestrator.transport import Transport, TransportError
 from mcpx.registry.base import RegistryBackend
-from mcpx.telemetry import tracing
+from mcpx.telemetry import provenance, tracing
 from mcpx.telemetry.metrics import Metrics
 from mcpx.telemetry.stats import TelemetryStore
 
@@ -266,6 +266,23 @@ class Orchestrator:
                     "attempt", t0=t0, t1=t1, kind=kind, status=status,
                     endpoint=url, **extra,
                 )
+            # Resilience skip verdicts are decisions, not outcomes: the
+            # chain chose NOT to spend an attempt. Both land in the
+            # request's provenance trail (no-op while the trail is off).
+            if status == "open":
+                provenance.emit(
+                    "resilience",
+                    f"circuit breaker open: skipped {url}",
+                    signals={"service": node.service},
+                    kind=kind,
+                )
+            elif status == "budget":
+                provenance.emit(
+                    "resilience",
+                    f"deadline budget refused {kind} attempt at {url}",
+                    signals={"service": node.service},
+                    kind=kind,
+                )
 
         last_error = ""
         backoff = self._cfg.retry_backoff_s
@@ -362,6 +379,14 @@ class Orchestrator:
                 continue
             nt.status = "ok"
             nt.finished_at = loop.time()
+            if kind == "fallback":
+                # The ordered-fallback chain rescuing a node is exactly the
+                # kind of "why did this succeed anyway" a trail must name.
+                provenance.emit(
+                    "resilience",
+                    f"fallback to {url} succeeded",
+                    signals={"service": node.service},
+                )
             return True, response
 
         nt.status = "failed"
@@ -431,6 +456,11 @@ class Orchestrator:
                         continue
                     if res.hedge.try_acquire():
                         res.record_hedge("launched")
+                        provenance.emit(
+                            "resilience",
+                            f"hedge launched to {hedge_url}",
+                            signals={"hedge_delay_s": round(hedge_delay, 4)},
+                        )
                         launch(hedge_url, "hedge")
                     else:
                         res.record_hedge("denied")
@@ -443,6 +473,9 @@ class Orchestrator:
                         record(u, kind, "ok", t0, t1)
                         if kind == "hedge":
                             res.record_hedge("win")
+                            provenance.emit(
+                                "resilience", f"hedge to {u} won the race"
+                            )
                         return t.result()
                     if not isinstance(exc, TransportError):
                         raise exc  # transport-layer bug: the node-isolation boundary reports it

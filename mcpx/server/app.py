@@ -37,6 +37,7 @@ from mcpx.scheduler import ShedError
 from mcpx.server.control import ControlPlane
 from mcpx.telemetry import ledger as ledger_mod
 from mcpx.telemetry import metrics as metrics_mod
+from mcpx.telemetry import provenance
 from mcpx.telemetry import tracing
 
 log = logging.getLogger("mcpx.server")
@@ -86,6 +87,7 @@ _UNTRACED = {
     "/metrics", "/costs", "/cache", "/traces", "/traces/{trace_id}",
     "/healthz", "/telemetry", "/debug/anomalies",
     "/debug/anomalies/{bundle_id}", "/usage", "/slo", "/cluster",
+    "/explain/{trace_id}",
 }
 
 # Request key the /plan handler uses to tell the middleware's SLO observe
@@ -144,6 +146,15 @@ def build_app(cp: ControlPlane) -> web.Application:
                 tenant=_tenant_of(request), endpoint=endpoint, t0=t0
             )
             bill_token = ledger_mod.activate(bill)
+        # Decision-provenance trail (mcpx/telemetry/provenance.py): rides
+        # the same contextvar pattern as the ledger bill. begin() is a
+        # no-op returning None while the recorder is disabled (the
+        # default), so the off path stays byte-identical pass-through.
+        prov_token = (
+            provenance.begin(cp.provenance)
+            if root is not None and limited_path
+            else None
+        )
         status = "error"
         # HTTP status class for tail sampling: only SERVER faults (5xx /
         # timeout) are always-kept — a bot scan of 404s or a stream of
@@ -190,6 +201,7 @@ def build_app(cp: ControlPlane) -> web.Application:
                     resp.headers["traceparent"] = tracing.format_traceparent(root)
                 return resp
         finally:
+            provenance.end(prov_token)
             if root is not None:
                 root.set(status=status)
             elapsed_s = time.monotonic() - t0  # mcpx: ignore[span-across-await-blocking] - the latency metric must exist when tracing is disabled or the trace unsampled
@@ -263,6 +275,13 @@ def build_app(cp: ControlPlane) -> web.Application:
                     # trace must say WHICH gate refused (rate/queue/deadline).
                     if ssp is not None:
                         ssp.set(verdict=e.outcome, retry_after_s=e.retry_after_s)
+                    provenance.emit(
+                        "sched",
+                        f"shed ({e.outcome})",
+                        signals={"retry_after_s": e.retry_after_s},
+                        tenant=ctx.tenant,
+                        weight=ctx.weight,
+                    )
                     return _json_error(
                         429,
                         f"admission refused: {e}",
@@ -276,6 +295,20 @@ def build_app(cp: ControlPlane) -> web.Application:
                         verdict="degraded" if slot.degraded else "admitted",
                         queue_wait_ms=round(slot.queue_wait_s * 1e3, 3),
                     )
+                provenance.emit(
+                    "sched",
+                    (
+                        "admitted to degraded tier (shortlist planner)"
+                        if slot.degraded
+                        else "admitted (primary tier)"
+                    ),
+                    alternatives=["admitted", "degraded", "shed"],
+                    signals={
+                        "queue_wait_ms": round(slot.queue_wait_s * 1e3, 3)
+                    },
+                    tenant=slot.ctx.tenant,
+                    weight=ctx.weight,
+                )
         bill = ledger_mod.current_bill()
         if slot is not None:
             if bill is not None:
@@ -483,6 +516,24 @@ def build_app(cp: ControlPlane) -> web.Application:
             # chrome://tracing (docs/observability.md; `mcpx trace dump`).
             return web.json_response(rec.to_chrome())
         return web.json_response(rec.to_dict())
+
+    async def explain_handler(request: web.Request) -> web.Response:
+        """Decision-provenance explanation for one retained trace
+        (mcpx/telemetry/provenance.py, docs/observability.md): the
+        ``decision.*`` spans a request's consequential choice points
+        emitted, re-rendered as structured JSON plus a human-readable
+        narrative — admission verdict, plan origin with retrieval scores,
+        routing winner with per-policy contributions, resilience events,
+        replans, prefix-cache outcomes, in request order. Works on any
+        retained trace; a trace recorded while provenance was disabled
+        answers with an empty decision list and says so in the narrative."""
+        tid = request.match_info["trace_id"]
+        rec = cp.tracer.get(tid)
+        if rec is None:
+            return _json_error(
+                404, f"no trace '{tid}' (evicted, unsampled, or never existed)"
+            )
+        return web.json_response(provenance.build_explanation(rec))
 
     async def costs_handler(request: web.Request) -> web.Response:
         """Roofline cost observatory (mcpx/telemetry/costs.py,
@@ -709,12 +760,14 @@ def build_app(cp: ControlPlane) -> web.Application:
     app.router.add_get("/cache", cache_handler)
     app.router.add_get("/traces", traces_handler)
     app.router.add_get("/traces/{trace_id}", trace_get)
+    app.router.add_get("/explain/{trace_id}", explain_handler)
     app.router.add_get("/debug/anomalies", anomalies_handler)
     app.router.add_get("/debug/anomalies/{bundle_id}", anomaly_bundle_handler)
     async def cluster_handler(request: web.Request) -> web.Response:
         """Replica-pool scoreboard (mcpx/cluster/, docs/cluster.md):
-        per-replica lifecycle/depth/ETA/error-rate rows, routing tallies
-        and the last routing decision. Disabled-subsystem convention:
+        per-replica lifecycle/depth/ETA/error-rate rows, routing tallies,
+        the bounded recent-decision ring (entries carry trace ids) and
+        the routing/failover journal. Disabled-subsystem convention:
         {"enabled": false}, not a 404 (same as /usage and /slo)."""
         pool = getattr(cp, "cluster", None)
         if pool is None:

@@ -22,7 +22,7 @@ from mcpx.orchestrator.executor import ExecuteResult, Orchestrator
 from mcpx.planner.base import PlanContext, Planner
 from mcpx.planner.heuristic import HeuristicPlanner
 from mcpx.registry.base import RegistryBackend
-from mcpx.telemetry import tracing
+from mcpx.telemetry import provenance, tracing
 from mcpx.telemetry.metrics import Metrics
 from mcpx.telemetry.replan import ReplanPolicy
 from mcpx.telemetry.stats import TelemetryStore
@@ -145,6 +145,12 @@ class ControlPlane:
         from mcpx.telemetry.flight import build_flight_recorder
 
         self.flight = build_flight_recorder(self)
+        # Decision-provenance recorder (mcpx/telemetry/provenance.py):
+        # per-request "why" records + GET /explain. None while
+        # telemetry.provenance.enabled=false — the middleware then never
+        # begins a trail and every emit() stays a no-op (byte-identical
+        # pass-through, parity-tested).
+        self.provenance = provenance.build_provenance(self)
         # Degradation target: the model-free shortlist planner — it still
         # plans over the retrieval shortlist via _context, so degraded
         # service is the "shortlist planner" tier, not a blind fallback.
@@ -213,6 +219,10 @@ class ControlPlane:
                     self.metrics.plan_cache.labels(result="hit").inc()
                     if sp is not None:
                         sp.set(cache="hit", origin=cached.origin)
+                    provenance.emit(
+                        "plan", "plan-cache hit (local tier)",
+                        origin=cached.origin or "unknown",
+                    )
                     return cached, (time.monotonic() - t0) * 1e3  # mcpx: ignore[span-across-await-blocking] - latency_ms is a client response field, served with tracing off too
             if use_cache and self.redis_plan_cache is not None:
                 # Second tier: shared across replicas/restarts, independent of
@@ -226,6 +236,10 @@ class ControlPlane:
                     self.metrics.plan_cache.labels(result="redis_hit").inc()
                     if sp is not None:
                         sp.set(cache="redis_hit", origin=shared.origin)
+                    provenance.emit(
+                        "plan", "plan-cache hit (redis tier)",
+                        origin=shared.origin or "unknown",
+                    )
                     return shared, (time.monotonic() - t0) * 1e3  # mcpx: ignore[span-across-await-blocking] - latency_ms is a client response field, served with tracing off too
             if use_cache and (local_tier or self.redis_plan_cache is not None):
                 self.plan_cache_stats["misses"] += 1
@@ -241,6 +255,8 @@ class ControlPlane:
                     intent, version=version, deadline_at=deadline_at,
                     tenant=tenant,
                 )
+            n_spans0 = len(sp.record.spans) if sp is not None else 0
+            tier0 = self._tier_counts() if provenance.active() else None
             try:
                 plan = await planner.plan(intent, context)
                 self.metrics.plans.labels(
@@ -255,11 +271,97 @@ class ControlPlane:
                 raise
             if sp is not None:
                 sp.set(origin=plan.origin or "unknown")
+            if provenance.active():
+                self._emit_plan_provenance(
+                    intent, plan, planner, context, degraded=degraded
+                )
+                self._emit_prefix_provenance(
+                    sp.record.spans[n_spans0:] if sp is not None else [],
+                    tier0,
+                )
             if use_cache and not degraded and self.config.planner.plan_cache_size > 0:
                 self._cache_put(key, plan)
             if use_cache and not degraded and self.redis_plan_cache is not None:
                 self._redis_cache_write(intent, version, plan)
             return plan, (time.monotonic() - t0) * 1e3  # mcpx: ignore[span-across-await-blocking] - latency_ms is a client response field, served with tracing off too
+
+    # ------------------------------------------------------------ provenance
+    def _emit_plan_provenance(
+        self, intent: str, plan: Plan, planner: Any, context: PlanContext,
+        *, degraded: bool,
+    ) -> None:
+        """DecisionRecord for the planner outcome (active trail only):
+        origin, grammar mode, the retrieval shortlist that formed the
+        planner's universe — with its embedding scores when the retriever
+        can produce them (contributions)."""
+        scores: dict[str, float] = {}
+        sf = getattr(self.retriever, "scores_for", None)
+        if sf is not None and context.shortlist:
+            try:
+                scores = sf(intent, list(context.shortlist))
+            except Exception:  # mcpx: ignore[broad-except] - provenance must never fail a plan; the record just loses its scores
+                scores = {}
+        provenance.emit(
+            "plan",
+            f"planned via {type(planner).__name__} "
+            f"(origin={plan.origin or 'unknown'})",
+            alternatives=list(context.shortlist or []),
+            contributions=scores,
+            origin=plan.origin or "unknown",
+            grammar_mode=self.config.planner.constrain_names,
+            degraded=degraded,
+            shortlist_k=self.config.planner.shortlist_top_k,
+            excluded=sorted(context.exclude) if context.exclude else [],
+        )
+
+    def _tier_counts(self) -> Optional[dict]:
+        """Cumulative KV spill/readmit counts (provenance-only read): the
+        plan window's delta attributes tier churn to the request that
+        observed it."""
+        engine = getattr(self.planner, "engine", None)
+        if engine is None or getattr(engine, "state", None) != "ready":
+            return None
+        try:
+            qs = engine.queue_stats()
+        except Exception:  # mcpx: ignore[broad-except] - provenance must never fail a plan; the record just loses tier signals
+            return None
+        return {
+            "spills": int(qs.get("prefix_spills", 0)),
+            "readmits": int(qs.get("prefix_readmits", 0)),
+        }
+
+    def _emit_prefix_provenance(
+        self, new_spans: list, tier0: Optional[dict]
+    ) -> None:
+        """Prefix-cache/tier DecisionRecords from the engine-worker spans
+        the plan just added. The worker thread cannot emit (contextvars
+        don't cross threads), so the loop re-emits from the span tree
+        after generate returns; spill/readmit churn over the plan window
+        rides as signals."""
+        for s in list(new_spans):
+            if s.name != "engine.prefill":
+                continue
+            a = s.attrs
+            if "prefix_matched_tokens" not in a:
+                continue
+            matched = int(a.get("prefix_matched_tokens", 0))
+            provenance.emit(
+                "prefix",
+                "prefix cache "
+                + (f"hit ({matched} tokens)" if a.get("prefix_hit") else "miss"),
+                signals={"matched_tokens": matched},
+            )
+        tier1 = self._tier_counts() if tier0 is not None else None
+        if tier0 is not None and tier1 is not None:
+            d_spill = tier1["spills"] - tier0["spills"]
+            d_readmit = tier1["readmits"] - tier0["readmits"]
+            if d_spill > 0 or d_readmit > 0:
+                provenance.emit(
+                    "prefix",
+                    f"kv tier churn during plan window ({d_spill} spill(s), "
+                    f"{d_readmit} readmit(s))",
+                    signals={"spills": d_spill, "readmits": d_readmit},
+                )
 
     def _redis_cache_write(self, intent: str, version: int, plan: Plan) -> None:
         """Fire-and-forget write to the shared tier: put() swallows its own
@@ -369,6 +471,14 @@ class ControlPlane:
                 exclude |= decision.exclude
                 self.metrics.replans.inc()
                 trace.replans += 1
+                provenance.emit(
+                    "replan",
+                    f"replan attempt {trace.replans}: "
+                    + ("; ".join(decision.reasons) or "policy"),
+                    alternatives=sorted(decision.exclude),
+                    signals={"status": result.status},
+                    excluded=sorted(exclude),
+                )
                 context = await self._context(
                     intent, exclude, replan_prior=prior or None, tenant=tenant
                 )
@@ -383,6 +493,12 @@ class ControlPlane:
                         trace.replans,
                     )
                     break
+                if provenance.active():
+                    # The repaired plan's origin record (the replan loop
+                    # calls the planner directly, not through plan()).
+                    self._emit_plan_provenance(
+                        intent, plan, self.planner, context, degraded=False
+                    )
                 result = await self.execute(plan, payload, trace)
         finally:
             if pin is not None:

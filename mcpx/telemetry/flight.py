@@ -366,6 +366,20 @@ _DETECTOR_SPECS: tuple[dict, ...] = (
     # skipped — recorder-off parity untouched.
     dict(name="replica_skew", signal="replica_skew", direction="high",
          floor=2.0),
+    # Cluster decision-outcome signals (ISSUE 19, per-window deltas of the
+    # pool's routing-journal counts; absent without a pool = skipped):
+    # affinity hit rate collapsing means repeat traffic stopped landing on
+    # its KV-warm replica (replica churn, imbalance hatch stuck open).
+    dict(name="affinity_collapse", signal="affinity_hit_rate",
+         direction="low", floor=0.25),
+    # Sustained mid-request re-steers = replicas dying under load.
+    dict(name="resteer_storm", signal="resteer_rate", direction="high",
+         floor=0.5),
+    # Share of routes where affinity preferred a replica but the summed
+    # score placed the request elsewhere — the pool trading KV reuse for
+    # queueing relief; a surge means placement quality degraded.
+    dict(name="degraded_route_surge", signal="degraded_route_share",
+         direction="high", floor=0.35),
 )
 
 
@@ -632,6 +646,17 @@ class FlightRecorder:
             signals["decode_dispatches_per_token"] = None
         spill_rate = rate("spill_events_total")
         signals["spill_thrash_rate"] = spill_rate
+        # Cluster decision-outcome signals (ISSUE 19): window deltas of
+        # the pool's routing-journal counts. Keys absent without a pool —
+        # every signal stays None and the cluster detectors skip.
+        if "cluster_routed_total" in raw:
+            signals["affinity_hit_rate"] = window_ratio(
+                "cluster_affinity_hit_total", ("cluster_routed_total",)
+            )
+            signals["degraded_route_share"] = window_ratio(
+                "cluster_degraded_route_total", ("cluster_routed_total",)
+            )
+            signals["resteer_rate"] = rate("cluster_resteer_total")
         # Shed rate: share of scheduler decisions this window that shed.
         if prev is not None:
             d_all = raw.get("sched_decisions_total", 0.0) - prev.get(
@@ -896,6 +921,18 @@ def build_flight_recorder(cp: Any) -> Optional["FlightRecorder"]:
             # detector's watch — one hot replica trips a bundle carrying
             # the scoreboard that names it.
             raw["replica_skew"] = float(pool.replica_skew())
+            # Routing-journal counts: the cumulative decision outcomes the
+            # recorder deltas into affinity_hit_rate / resteer_rate /
+            # degraded_route_share (ISSUE 19 window-delta signals).
+            counts = pool.journal_counts()
+            raw["cluster_routed_total"] = float(counts.get("routed", 0))
+            raw["cluster_affinity_hit_total"] = float(
+                counts.get("affinity_hit", 0)
+            )
+            raw["cluster_degraded_route_total"] = float(
+                counts.get("degraded_route", 0)
+            )
+            raw["cluster_resteer_total"] = float(counts.get("resteer", 0))
         return raw
 
     def traces_source() -> list[dict]:
@@ -948,6 +985,10 @@ def build_flight_recorder(cp: Any) -> Optional["FlightRecorder"]:
         # A replica_skew bundle names the hot replica: the scoreboard rides
         # along (per-replica depth/ETA/error-rate/lifecycle rows).
         sources["cluster"] = pool.scoreboard_snapshot
+        # Per-replica decision attribution (ISSUE 19): which decisions put
+        # load where — recent routing decisions + trace ids per replica,
+        # policy winners, signal-ring tails, the failover journal.
+        sources["cluster_attribution"] = pool.attribution
     specs = _DETECTOR_SPECS
     if slo is not None:
         # The slo_burn floor follows the CONFIGURED page threshold — a

@@ -496,6 +496,26 @@ class Metrics:
             "choice died mid-request",
             registry=self.registry,
         )
+        # Decision provenance (mcpx/telemetry/provenance.py): which policy
+        # decided routing, and how many "why" records each layer emits.
+        # policy_winner is the pipeline's bounded policy-name set; layer is
+        # provenance.LAYERS (unknown layers fold into "other") — neither
+        # grows with traffic. Routing decisions carry exemplar trace ids
+        # (OpenMetrics exposition only) like the PR 4 latency histograms.
+        self.route_decisions = Counter(
+            "mcpx_route_decisions_total",
+            "Cluster routing decisions by the policy contributing most to "
+            "the winning replica's score",
+            ["policy_winner"],
+            registry=self.registry,
+        )
+        self.provenance_records = Counter(
+            "mcpx_provenance_records_total",
+            "DecisionRecords emitted per layer "
+            "(sched/plan/route/resilience/replan/prefix)",
+            ["layer"],
+            registry=self.registry,
+        )
         # Scheduler (mcpx/scheduler/): admission decisions, queue wait, and
         # ladder state. outcome: admitted | degraded (admitted but routed to
         # the shortlist planner by the degradation ladder) | shed_rate |

@@ -82,6 +82,7 @@ def _stats(**overrides):
         "ledger": None,
         "kernel": None,
         "cluster": None,
+        "provenance": None,
         "pallas_paths": {
             "enabled": True,
             "interpret": True,
@@ -283,6 +284,31 @@ def test_output_promotes_cluster_phase_acceptance_keys():
     assert out["cluster_scaling_linearity"] is None
     assert out["cluster_routed_token_hit_rate"] is None
     assert out["cluster_warm_rejoin_prefill_ratio"] is None
+
+
+def test_output_promotes_provenance_phase_acceptance_keys():
+    """ISSUE 19: when the decision-provenance phase ran, the recorder's
+    overhead fraction and the /explain schema-coverage fraction are
+    promoted to the top level for TRACKED_METRICS regression tracking."""
+    provenance = {
+        "requests": 96,
+        "rounds": 3,
+        "plans_per_sec_off": 50.0,
+        "plans_per_sec_on": 49.7,
+        "provenance_overhead_frac": 0.006,
+        "explanation_coverage": 1.0,
+        "decisions_per_request": 1.5,
+        "records_emitted": 144,
+    }
+    out = bench._output_json(_stats(provenance=provenance), None, "test")
+    assert out["provenance"]["decisions_per_request"] == 1.5
+    assert out["provenance_overhead_frac"] == 0.006
+    assert out["explanation_coverage"] == 1.0
+    # Skipped phase: block and promoted keys null, never absent.
+    out = bench._output_json(_stats(), None, "test")
+    assert out["provenance"] is None
+    assert out["provenance_overhead_frac"] is None
+    assert out["explanation_coverage"] is None
 
 
 def test_measurement_basis_labels_the_platform(monkeypatch):

@@ -81,3 +81,54 @@ def test_config_file_plumbing(tmp_path):
 
     cfg = _load_config(argparse.Namespace(config=str(cfg_path), registry_file=None, planner=None))
     assert cfg.server.port == 9123 and cfg.planner.kind == "mock"
+
+
+def test_explain_cli_defaults_to_newest_trace(tmp_path, capsys):
+    """``mcpx explain`` with no trace id explains the newest retained
+    trace — the "what just happened" workflow, alongside ``mcpx debug``."""
+    reg_path = tmp_path / "registry.json"
+    assert main(["gen-registry", "3", "--out", str(reg_path), "--seed", "7"]) == 0
+    records = json.loads(reg_path.read_text())
+
+    async def go():
+        from aiohttp import ClientSession
+        from aiohttp.test_utils import TestServer
+
+        from mcpx.cli.main import _load_config
+        from mcpx.server.app import build_app
+        from mcpx.server.factory import build_control_plane
+
+        import argparse
+
+        args = argparse.Namespace(
+            config=None, registry_file=str(reg_path), planner="heuristic"
+        )
+        cfg = _load_config(args)
+        cfg.telemetry.provenance.enabled = True
+        cp = build_control_plane(cfg)
+        server = TestServer(build_app(cp))
+        await server.start_server()
+        base = f"http://{server.host}:{server.port}"
+        try:
+            async with ClientSession() as s:
+                async with s.post(
+                    f"{base}/plan", json={"intent": f"use {records[0]['name']}"}
+                ) as r:
+                    assert r.status == 200
+            out_path = str(tmp_path / "explained.json")
+            rc = await asyncio.to_thread(
+                main, ["explain", "--url", base, "--out", out_path]
+            )
+            assert rc == 0
+            explanation = json.loads((tmp_path / "explained.json").read_text())
+            assert explanation["decisions"], "newest trace carries decisions"
+            assert any(d["layer"] == "plan" for d in explanation["decisions"])
+        finally:
+            await server.close()
+
+    asyncio.run(go())
+    assert "planned via" in capsys.readouterr().out
+
+    # No server behind the URL: a clean JSON error, not a traceback.
+    assert main(["explain", "t-1", "--url", "http://127.0.0.1:1"]) == 1
+    assert "error" in json.loads(capsys.readouterr().out.splitlines()[-1])

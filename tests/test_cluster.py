@@ -510,3 +510,55 @@ def test_pool_is_engine_shaped():
         assert pool.pallas_paths()["decode"]["engaged"] is False
 
     asyncio.run(go())
+
+
+# ----------------------------------------------------- decision provenance
+def test_routing_ring_bounded_and_last_decision_compat():
+    """The pipeline keeps a bounded ring of decisions (not one global),
+    `last_decision` stays the newest entry for back-compat, and every
+    entry carries a trace_id slot for /explain cross-referencing."""
+    hs = _ready_handles(3)
+    pipe = RoutingPipeline([QueueDepthPolicy(), RoundRobinPolicy()], ring_size=4)
+    for i in range(10):
+        pipe.route(RouteRequest(prompt_ids=(i,)), hs)
+    assert len(pipe.decisions) == 4  # bounded, oldest evicted
+    assert len(pipe.recent_decisions()) == 4
+    assert pipe.last_decision == pipe.recent_decisions()[-1]
+    for d in pipe.recent_decisions():
+        assert {"ts", "replica", "policy_winner", "trace_id", "scores",
+                "policies"} <= set(d)
+        assert d["trace_id"] == ""  # no active trace in this test
+    # Empty ring: property degrades to {} rather than raising.
+    assert RoutingPipeline([QueueDepthPolicy()]).last_decision == {}
+
+
+def test_pool_journal_counts_attribution_and_snapshot_keys():
+    async def go():
+        pool, _ = _pool(2)
+        await pool.start()
+        for _ in range(4):
+            await pool.generate([1, 2, 3])
+        await pool.kill(1)
+        await pool.rejoin(1)
+        counts = pool.journal_counts()
+        assert counts["routed"] == 4
+        assert counts["kill"] == 1 and counts["rejoin"] == 1
+        kinds = [e["kind"] for e in pool.journal.tail()]
+        assert kinds.index("kill") < kinds.index("rejoin")
+
+        attr = pool.attribution()
+        assert set(attr) == {"replicas", "journal", "journal_counts"}
+        assert set(attr["replicas"]) == {"0", "1"}
+        row = attr["replicas"]["0"]
+        for key in ("state", "routed", "affinity_hits", "resteered_away",
+                    "inflight", "recent_decisions", "policy_winners",
+                    "recent_trace_ids", "signals"):
+            assert key in row, key
+        assert sum(r["routed"] for r in attr["replicas"].values()) == 4
+
+        snap = pool.scoreboard_snapshot()
+        assert {"decisions", "journal", "journal_counts"} <= set(snap)
+        assert len(snap["decisions"]) <= pool.config.telemetry.provenance.route_ring
+        await pool.aclose()
+
+    asyncio.run(go())

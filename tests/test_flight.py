@@ -471,3 +471,87 @@ def test_bundle_schema_validator_rejects_malformed():
     assert any("version" in p for p in problems)
     assert any("trigger" in p for p in problems)
     assert any("window" in p for p in problems)
+
+
+def test_recorder_derives_cluster_decision_outcome_signals(tmp_path):
+    """ISSUE 19: per-window deltas of the pool's routing-journal counts
+    become decision-outcome signals; without a pool the keys are absent
+    and every cluster detector skips (recorder parity untouched)."""
+    raw = {}
+    clock = {"now": 0.0}
+    rec = FlightRecorder(
+        _flight_cfg(tmp_path), lambda: dict(raw), clock=lambda: clock["now"]
+    )
+    rec.sample()
+    assert "affinity_hit_rate" not in rec.ring[-1]["signals"]
+    # A pool appears: first sampled window with the journal counters.
+    raw.update({
+        "cluster_routed_total": 100.0,
+        "cluster_affinity_hit_total": 80.0,
+        "cluster_degraded_route_total": 10.0,
+        "cluster_resteer_total": 0.0,
+    })
+    clock["now"] += 1.0
+    rec.sample()
+    # Next window: 100 more routes, 20 affinity hits, 30 degraded, 2
+    # resteers — the signals are THIS window's ratios, not lifetime.
+    raw.update({
+        "cluster_routed_total": 200.0,
+        "cluster_affinity_hit_total": 100.0,
+        "cluster_degraded_route_total": 40.0,
+        "cluster_resteer_total": 2.0,
+    })
+    clock["now"] += 1.0
+    rec.sample()
+    sig = rec.ring[-1]["signals"]
+    assert sig["affinity_hit_rate"] == 0.2
+    assert sig["degraded_route_share"] == 0.3
+    assert sig["resteer_rate"] == 2.0
+    # The SPC detectors watching them are registered by default.
+    watched = {d.signal for d in rec.detectors}
+    assert {"affinity_hit_rate", "resteer_rate",
+            "degraded_route_share"} <= watched
+
+
+def test_bundle_carries_cluster_attribution(tmp_path):
+    """ISSUE 19 acceptance: with a replica pool attached, bundles carry a
+    ``cluster_attribution`` source — per-replica decision attribution
+    (lifetime counters, recent ring decisions + policy winners, signal
+    rings) plus the failover journal."""
+    from mcpx.telemetry.flight import build_flight_recorder
+    from tests.test_cluster import _pool
+
+    svc = FakeService("svc", result={"ok": True})
+    transport = RouterTransport(local=make_transport(svc))
+    config = MCPXConfig.from_dict(
+        {"telemetry": {"flight": {
+            "enabled": True, "interval_s": 3600.0,
+            "bundle_dir": str(tmp_path),
+        }}}
+    )
+    cp = build_control_plane(config, transport=transport)
+
+    async def go():
+        pool, _ = _pool(2)
+        await pool.start()
+        for _ in range(3):
+            await pool.generate([1, 2, 3])
+        await pool.kill(1)
+        cp.cluster = pool
+        fl = build_flight_recorder(cp)
+        fl.sample()
+        bundle = fl._assemble(
+            {"detector": "replica_skew", "signal": "replica_skew",
+             "direction": "high", "value": 3.0, "mean": 1.0, "band": 0.2}
+        )
+        attr = bundle["cluster_attribution"]
+        assert set(attr["replicas"]) == {"0", "1"}
+        assert sum(r["routed"] for r in attr["replicas"].values()) == 3
+        assert attr["journal_counts"]["kill"] == 1
+        assert any(e["kind"] == "kill" for e in attr["journal"])
+        # The scoreboard source rides along and the bundle stays valid.
+        assert "journal_counts" in bundle["cluster"]
+        assert validate_bundle(bundle) == []
+        await pool.aclose()
+
+    asyncio.run(go())

@@ -1,0 +1,466 @@
+"""Decision-provenance spine (mcpx/telemetry/provenance.py): emit/trail
+semantics, the /explain schema + narrative contract, the end-to-end chaos
+acceptance (breaker-open → fallback-chain failure → replan → replica
+resteer on ONE request, every decision named in order), provenance-off
+byte-parity, and tail-sampling keep-on-error."""
+
+import asyncio
+import json
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from mcpx.cluster import EnginePool
+from mcpx.core.config import MCPXConfig
+from mcpx.core.dag import Plan
+from mcpx.core.errors import EngineError
+from mcpx.orchestrator.transport import RouterTransport
+from mcpx.planner.mock import MockPlanner
+from mcpx.resilience.chaos import ChaosProfile, ChaosTransport
+from mcpx.server.app import build_app
+from mcpx.server.factory import build_control_plane
+from mcpx.telemetry import provenance, tracing
+from mcpx.telemetry.provenance import (
+    ProvenanceRecorder,
+    build_explanation,
+    validate_explanation,
+)
+from mcpx.telemetry.tracing import Tracer
+
+from tests.helpers import FakeService, make_transport
+
+
+# ------------------------------------------------------------------ unit: emit
+def _recorder(max_records=64, metrics=None):
+    cfg = MCPXConfig().telemetry.provenance
+    cfg.enabled = True
+    cfg.max_records_per_trace = max_records
+    return ProvenanceRecorder(cfg, metrics=metrics)
+
+
+def test_emit_requires_trail_and_span():
+    rec = _recorder()
+    # No trail, no span: no-op.
+    assert provenance.emit("plan", "x") is False
+    token = provenance.begin(rec)
+    try:
+        # Trail without a current span still refuses (nothing to attach to).
+        assert not provenance.active()
+        assert provenance.emit("plan", "x") is False
+        tracer = Tracer(None, enabled=True, sample_rate=1.0)
+        root = tracer.start_request("/plan")
+        with tracing.activate(root):
+            assert provenance.active()
+            assert provenance.emit("plan", "picked A", alternatives=["B"])
+        tracer.finish(root)
+        got = tracer.get(root.record.trace_id)
+        names = [s.name for s in got.spans]
+        assert "decision.plan" in names
+    finally:
+        provenance.end(token)
+    assert rec.records_emitted == 1
+    # begin(None) is the disabled path: token None, end(None) a no-op.
+    assert provenance.begin(None) is None
+    provenance.end(None)
+
+
+def test_emit_cap_drops_and_explanation_reports_it():
+    rec = _recorder(max_records=3)
+    tracer = Tracer(None, enabled=True, sample_rate=1.0)
+    root = tracer.start_request("/plan")
+    token = provenance.begin(rec)
+    try:
+        with tracing.activate(root):
+            results = [provenance.emit("plan", f"d{i}") for i in range(5)]
+    finally:
+        provenance.end(token)
+    tracer.finish(root)
+    assert results == [True, True, True, False, False]
+    exp = build_explanation(tracer.get(root.record.trace_id))
+    assert validate_explanation(exp) == []
+    assert len(exp["decisions"]) == 3
+    assert exp["dropped"] == 2
+    assert [d["seq"] for d in exp["decisions"]] == [1, 2, 3]
+    assert any("dropped" in line for line in exp["narrative"])
+
+
+def test_empty_trail_explains_honestly():
+    tracer = Tracer(None, enabled=True, sample_rate=1.0)
+    root = tracer.start_request("/plan")
+    tracer.finish(root)
+    exp = build_explanation(tracer.get(root.record.trace_id))
+    assert validate_explanation(exp) == []
+    assert exp["decisions"] == [] and exp["layers"] == []
+    assert any("no decision records" in line for line in exp["narrative"])
+
+
+def test_validate_explanation_rejects_malformed():
+    assert validate_explanation(None) == ["explanation is not an object"]
+    problems = validate_explanation({"decisions": [{"layer": "plan"}]})
+    assert any("trace_id" in p for p in problems)
+    assert any("missing key 'seq'" in p for p in problems)
+    bad_order = {
+        "trace_id": "t", "name": "/plan", "total_ms": 1.0, "error": False,
+        "layers": ["plan"], "narrative": ["x"],
+        "decisions": [
+            {"seq": 2, "layer": "plan", "choice": "b", "t_ms": 0.0},
+            {"seq": 1, "layer": "plan", "choice": "a", "t_ms": 0.0},
+        ],
+    }
+    assert "decisions are not in seq order" in validate_explanation(bad_order)
+
+
+def test_unknown_layer_folds_into_other_metric_label():
+    from mcpx.telemetry.metrics import Metrics
+
+    m = Metrics()
+    rec = _recorder(metrics=m)
+    tracer = Tracer(None, enabled=True, sample_rate=1.0)
+    root = tracer.start_request("/plan")
+    token = provenance.begin(rec)
+    try:
+        with tracing.activate(root):
+            provenance.emit("plan", "ok")
+            provenance.emit("not-a-layer", "typo'd layer")
+    finally:
+        provenance.end(token)
+    tracer.finish(root)
+    text = m.render().decode()
+    assert 'mcpx_provenance_records_total{layer="plan"} 1.0' in text
+    assert 'mcpx_provenance_records_total{layer="other"} 1.0' in text
+
+
+# --------------------------------------------------------------- e2e: chaos
+class DyingClusterEngine:
+    """Duck-typed pool replica: the FIRST generate anywhere in the pool
+    kills its replica mid-request (the chaos kill shape) so the pool
+    resteers; every later generate succeeds instantly."""
+
+    first_call = {"pending": True}
+
+    def __init__(self, index):
+        self.index = index
+        self.state = "cold"
+        self.tokenizer = None
+        self.metrics = None
+        self.costs = None
+
+    async def start(self):
+        self.state = "ready"
+
+    async def aclose(self):
+        self.state = "closed"
+
+    async def generate(self, prompt_ids, **kw):
+        if self.state != "ready":
+            raise EngineError(f"engine not ready (state={self.state})")
+        if DyingClusterEngine.first_call["pending"]:
+            DyingClusterEngine.first_call["pending"] = False
+            self.state = "failed"
+            raise EngineError("chaos: replica killed mid-request")
+        return {"replica": self.index}
+
+    def queue_stats(self):
+        return {"depth": 0, "active": 0, "service_ewma_s": 0.01, "eta_s": 0.0}
+
+    def prefix_cache_stats(self):
+        return {}
+
+    def prompt_capacity(self, max_new_tokens=0, shared_prefix_len=0):
+        return 100
+
+    def pallas_paths(self):
+        return {}
+
+
+FLAKY_PLAN = Plan.from_wire(
+    {
+        "nodes": [
+            {"name": "f", "service": "flaky", "endpoint": "local://flaky",
+             "retries": 2, "timeout_s": 2.0},
+        ],
+        "edges": [],
+    }
+)
+STABLE_PLAN = Plan.from_wire(
+    {
+        "nodes": [
+            {"name": "s", "service": "stable", "endpoint": "local://stable",
+             "retries": 0, "timeout_s": 2.0},
+        ],
+        "edges": [],
+    }
+)
+
+
+def test_chaos_request_explains_every_decision_in_order(tmp_path):
+    """The ISSUE 19 acceptance: a seeded ChaosTransport fails every call
+    to the primary endpoint, so one /plan_and_execute request routes on
+    the cluster pool (replica dies mid-generate → resteer), plans, trips
+    the breaker open mid-attempt-chain, fails the node, replans around
+    the exclusion, and succeeds — and GET /explain/{trace_id} names every
+    one of those decisions in emission order, narrative included.
+    `mcpx explain` round-trips the same payload."""
+    DyingClusterEngine.first_call["pending"] = True
+    stable = FakeService("stable", result={"ok": True})
+    flaky = FakeService("flaky", result={"ok": True})
+    base_transport = RouterTransport(local=make_transport(stable, flaky))
+    chaos = ChaosTransport(
+        base_transport,
+        ChaosProfile.from_dict(
+            {"seed": 42,
+             "endpoints": {"local://flaky": {"error_rate": 1.0,
+                                             "error_status": 500}}}
+        ),
+    )
+    config = MCPXConfig.from_dict(
+        {
+            "telemetry": {"provenance": {"enabled": True}},
+            "resilience": {
+                "enabled": True,
+                "breaker_consecutive_failures": 2,
+                "breaker_min_samples": 50,
+                "hedge_enabled": False,
+            },
+        }
+    )
+
+    pool_holder = {}
+
+    async def factory(intent, context):
+        # The mock "LLM": one pool.generate per plan (the decode the real
+        # LLMPlanner would run), then a canned plan — around the excluded
+        # services, like the real planner's shortlist filtering.
+        await pool_holder["pool"].generate([1, 2, 3, 4], max_new_tokens=4)
+        return STABLE_PLAN if "flaky" in context.exclude else FLAKY_PLAN
+
+    cp = build_control_plane(
+        config, transport=chaos, planner=MockPlanner(factory=factory)
+    )
+    pool_cfg = MCPXConfig()
+    pool_cfg.cluster.replicas = 2
+    pool_cfg.telemetry.provenance.enabled = True
+    pool = EnginePool(
+        pool_cfg,
+        metrics=cp.metrics,
+        engine_factory=lambda i, _cfg: DyingClusterEngine(i),
+    )
+    pool_holder["pool"] = pool
+    app = build_app(cp)
+
+    async def go():
+        await pool.start()
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            resp = await client.post(
+                "/plan_and_execute",
+                json={"intent": "compose flaky then recover", "payload": {}},
+            )
+            assert resp.status == 200
+            body = await resp.json()
+            assert body["status"] == "ok" and body["replans"] == 1
+            tid = resp.headers["X-Trace-Id"]
+
+            resp = await client.get(f"/explain/{tid}")
+            assert resp.status == 200
+            exp = await resp.json()
+            assert validate_explanation(exp) == []
+            assert exp["trace_id"] == tid
+            assert {"plan", "route", "resilience", "replan"} <= set(
+                exp["layers"]
+            )
+            choices = [d["choice"] for d in exp["decisions"]]
+
+            def at(substr):
+                hits = [i for i, c in enumerate(choices) if substr in c]
+                assert hits, f"no decision matching {substr!r} in {choices}"
+                return hits[0]
+
+            # The causal order of the whole story, by seq: route → replica
+            # dies → resteer → re-route → plan → breaker trips open inside
+            # the attempt chain → replan (naming the breaker exclusion) →
+            # second plan → clean execute.
+            i_route = at("routed to replica")
+            i_resteer = at("resteer away from replica")
+            i_plan = at("planned via MockPlanner")
+            i_open = at("circuit breaker open: skipped local://flaky")
+            i_replan = at("replan attempt 1")
+            assert i_route < i_resteer < i_plan < i_open < i_replan
+            # Second plan (post-exclusion) lands after the replan decision.
+            assert any(
+                "planned via MockPlanner" in c
+                for c in choices[i_replan + 1:]
+            )
+            # Routing winner carries the per-policy contribution breakdown.
+            route_d = exp["decisions"][i_route]
+            assert route_d["layer"] == "route"
+            assert "queue" in "".join(route_d["contributions"])
+            # Replan decision names the failed node AND the breaker
+            # exclusion, and records what was excluded.
+            replan_d = exp["decisions"][i_replan]
+            assert "node 'f' failed" in replan_d["choice"]
+            assert "circuit breaker open" in replan_d["choice"]
+            assert replan_d["detail"]["excluded"] == ["flaky"]
+            # The narrative tells the same story in the same order.
+            text = "\n".join(exp["narrative"])
+            for needle in (
+                "resteer away from replica",
+                "circuit breaker open",
+                "replan attempt 1",
+            ):
+                assert needle in text
+
+            # Routing ring + failover journal cross-reference the trace.
+            ring = pool._pipeline.recent_decisions()
+            assert any(d["trace_id"] == tid for d in ring)
+            kinds = [e["kind"] for e in pool.journal.tail()]
+            assert "routed" in kinds and "resteer" in kinds
+            resteer_ev = next(
+                e for e in pool.journal.tail() if e["kind"] == "resteer"
+            )
+            assert resteer_ev["trace_id"] == tid
+            # Per-replica attribution names which replica was resteered.
+            attr = pool.attribution()
+            assert attr["replicas"][str(resteer_ev["replica"])][
+                "resteered_away"
+            ] == 1
+            # Counters: layer-labelled records + policy-winner routing.
+            text = cp.metrics.render().decode()
+            assert 'mcpx_provenance_records_total{layer="route"}' in text
+            assert "mcpx_route_decisions_total" in text
+
+            # CLI round trip: narrative + validated JSON, written to disk.
+            from mcpx.cli.main import main as cli_main
+
+            base = f"http://{client.server.host}:{client.server.port}"
+            out_path = str(tmp_path / "explain.json")
+            rc = await asyncio.to_thread(
+                cli_main, ["explain", tid, "--url", base, "--out", out_path]
+            )
+            assert rc == 0
+            with open(out_path) as f:
+                fetched = json.load(f)
+            assert validate_explanation(fetched) == []
+            assert fetched["trace_id"] == tid
+
+            # Unknown trace: 404 with a JSON error envelope.
+            resp = await client.get("/explain/nope")
+            assert resp.status == 404
+        finally:
+            await pool.aclose()
+            await client.close()
+
+    asyncio.run(go())
+
+
+# ------------------------------------------------------------------ parity
+def test_provenance_off_is_byte_identical_pass_through():
+    """Default config: no recorder is built, no trail ever begins, and the
+    span tree / response bodies are byte-identical to a provenance-enabled
+    run minus exactly the decision.* spans."""
+
+    def build(enabled):
+        svc = FakeService("svc", result={"ok": True})
+        cfg = MCPXConfig()
+        cfg.telemetry.provenance.enabled = enabled
+        cp = build_control_plane(
+            cfg, transport=RouterTransport(local=make_transport(svc))
+        )
+        return cp, build_app(cp)
+
+    cp_off, app_off = build(False)
+    cp_on, app_on = build(True)
+    assert cp_off.provenance is None
+    assert cp_on.provenance is not None
+
+    async def run(app):
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            # Register the same service, plan the same intent.
+            await client.post(
+                "/services",
+                json={"name": "svc", "endpoint": "local://svc",
+                      "description": "canned data service",
+                      "input_schema": {}, "output_schema": {}},
+            )
+            resp = await client.post("/plan", json={"intent": "use svc"})
+            assert resp.status == 200
+            body = await resp.json()
+            return body, resp.headers["X-Trace-Id"]
+        finally:
+            await client.close()
+
+    async def go():
+        body_off, tid_off = await run(app_off)
+        body_on, tid_on = await run(app_on)
+        # Response parity: identical modulo the latency measurement.
+        body_off.pop("latency_ms"), body_on.pop("latency_ms")
+        assert body_off == body_on
+        # Span-tree parity: ON adds ONLY decision.* spans.
+        names_off = [s.name for s in cp_off.tracer.get(tid_off).spans]
+        names_on = [s.name for s in cp_on.tracer.get(tid_on).spans]
+        assert names_off == [
+            n for n in names_on if not n.startswith("decision.")
+        ]
+        assert any(n.startswith("decision.") for n in names_on)
+        # Off trace still explains (honestly empty).
+        exp = build_explanation(cp_off.tracer.get(tid_off))
+        assert validate_explanation(exp) == []
+        assert exp["decisions"] == []
+
+    asyncio.run(go())
+
+
+def test_tail_sampling_keeps_decision_trail_on_error():
+    """sample_rate=0 + keep_errors: a healthy request's trail is dropped
+    with its trace, but a 504'd request keeps the full decision trail —
+    the tail-sampling contract the tentpole rides on."""
+    slow = FakeService("svc", result={"ok": True})
+    cfg = MCPXConfig.from_dict(
+        {
+            "telemetry": {"provenance": {"enabled": True}},
+            "tracing": {"sample_rate": 0.0, "keep_errors": True},
+            "server": {"request_timeout_s": 0.15},
+        }
+    )
+    transport = RouterTransport(local=make_transport(slow, latencies={"svc": 0.5}))
+    plan = Plan.from_wire(
+        {
+            "nodes": [{"name": "s", "service": "svc",
+                       "endpoint": "local://svc", "retries": 0,
+                       "timeout_s": 2.0}],
+            "edges": [],
+        }
+    )
+    cp = build_control_plane(cfg, transport=transport, planner=MockPlanner(plan))
+    app = build_app(cp)
+
+    async def go():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            # Healthy /plan: decisions emitted, but the trace is unsampled
+            # — nothing retained, /explain 404s.
+            resp = await client.post("/plan", json={"intent": "quick"})
+            assert resp.status == 200
+            tid_ok = resp.headers["X-Trace-Id"]
+            assert (await client.get(f"/explain/{tid_ok}")).status == 404
+            # Timed-out /plan_and_execute: 504 → always kept, trail intact.
+            resp = await client.post(
+                "/plan_and_execute", json={"intent": "slow", "payload": {}}
+            )
+            assert resp.status == 504
+            # Timeout responses return straight from the middleware (no
+            # X-Trace-Id header pass); the error envelope carries the id.
+            tid = (await resp.json())["trace_id"]
+            resp = await client.get(f"/explain/{tid}")
+            assert resp.status == 200
+            exp = await resp.json()
+            assert validate_explanation(exp) == []
+            assert exp["error"] is True
+            assert any(d["layer"] == "plan" for d in exp["decisions"])
+        finally:
+            await client.close()
+
+    asyncio.run(go())
